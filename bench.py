@@ -1,0 +1,61 @@
+"""Headline benchmark: simulated committed YCSB txns/sec on one chip.
+
+Mirrors the reference's metric of record — committed txns / measured second
+(``tput=`` in statistics/stats.cpp:437-447) — for the BASELINE.json config 2
+shape: YCSB, zipf contention, 50/50 read-write.  Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+vs_baseline is value / 1e6 — the fraction of the 1M txns/s north star
+(BASELINE.md: ">=1M simulated concurrent YCSB txns/s on a v5e-8"; we bench a
+single chip here).
+"""
+
+import json
+import time
+
+import jax
+import numpy as np
+
+from deneva_tpu.config import Config
+from deneva_tpu.engine.scheduler import Engine
+
+
+def main():
+    cfg = Config(
+        cc_alg="NO_WAIT",
+        batch_size=16384,
+        synth_table_size=1 << 24,   # 16M rows (paper-scale, BASELINE.md grid)
+        req_per_query=10,
+        zipf_theta=0.6,
+        tup_read_perc=0.5,
+        query_pool_size=1 << 16,
+        warmup_ticks=0,
+        backoff=True,
+    )
+    eng = Engine(cfg)
+    state = eng.init_state()
+
+    # compile + warm up to steady state; SAME trip count as the timed run —
+    # run_compiled's fori_loop treats n_ticks as static, so a different count
+    # would put a recompile inside the timed window
+    n_ticks = 300
+    state = eng.run_compiled(n_ticks, state)
+    committed_before = int(np.asarray(state.stats["txn_cnt"]))
+
+    t0 = time.perf_counter()
+    state = eng.run_compiled(n_ticks, state)
+    jax.block_until_ready(state.stats["txn_cnt"])
+    dt = time.perf_counter() - t0
+
+    s = eng.summary(state)
+    tput = (s["txn_cnt"] - committed_before) / dt
+    print(json.dumps({
+        "metric": "ycsb_nowait_zipf0.6_tput",
+        "value": round(float(tput), 1),
+        "unit": "committed_txns_per_sec",
+        "vs_baseline": round(float(tput) / 1e6, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,28 @@
+"""deneva_tpu — a TPU-native distributed concurrency-control simulation framework.
+
+A ground-up rebuild of the capabilities of Deneva (MIT's distributed OLTP
+concurrency-control testbed; reference layout surveyed in /root/repo/SURVEY.md).
+Instead of per-thread worker loops, per-row pthread latches and nanomsg message
+passing, every concurrency-control inner loop runs as a batched, jit'd JAX
+kernel over HBM-resident (txn x access) read/write-set tensors. Rows shard
+across chips with jax.sharding; 2PC votes and Calvin epochs resolve with
+collectives over ICI.
+
+Key ideas
+---------
+- The lock table is NOT a dense per-row array: 2PL lock state is the set of
+  granted (txn, access) entries, and arbitration each scheduler tick is a
+  sorted join + segment reductions over those entries (O(B*R log B*R),
+  independent of table size).
+- Timestamp-ordering state (wts/rts, MVCC version rings, MaaT bounds) lives in
+  dense per-row arrays updated with scatter-max — monotone, so incremental
+  updates never need "undo".
+- Waiting transactions are not parked on pointer lists; a WAITING txn simply
+  re-arbitrates its current access every tick with its original priority,
+  which is equivalent to a priority-ordered waiter queue.
+"""
+
+from deneva_tpu.config import Config
+
+__all__ = ["Config"]
+__version__ = "0.1.0"

@@ -1,0 +1,25 @@
+"""CC-algorithm kernel registry — the rebuild of the CC_ALG compile switch."""
+
+from deneva_tpu.cc.base import AccessDecision, CCPlugin
+from deneva_tpu.cc.no_wait import NoWait, WaitDie
+
+REGISTRY: dict[str, CCPlugin] = {}
+
+
+def register(plugin: CCPlugin) -> CCPlugin:
+    REGISTRY[plugin.name] = plugin
+    return plugin
+
+
+register(NoWait())
+register(WaitDie())
+
+
+def get(name: str) -> CCPlugin:
+    if name not in REGISTRY:
+        raise KeyError(f"CC algorithm {name!r} not registered "
+                       f"(have: {sorted(REGISTRY)})")
+    return REGISTRY[name]
+
+
+__all__ = ["AccessDecision", "CCPlugin", "REGISTRY", "register", "get"]

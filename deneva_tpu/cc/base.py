@@ -1,0 +1,74 @@
+"""CC-algorithm plugin boundary.
+
+The reference selects its algorithm at compile time (``#define CC_ALG``,
+config.h:101) and splices per-algorithm code into row_t::get_row and the
+worker loop with ``#if`` blocks.  Here the boundary is explicit: each
+algorithm is a plugin of jit-traceable batch kernels, registered in
+``deneva_tpu.cc.REGISTRY``.
+
+A plugin sees the whole scheduler tick at once:
+
+- ``access``   — decide grant/wait/abort for every active txn's *current*
+  access (the batched analog of row_t::get_row, storage/row.cpp:197-310).
+- ``validate`` — commit-time validation for every finishing txn (the analog
+  of TxnManager::validate: OCC central_validate, MaaT validate; trivial for
+  2PL, concurrency_control/occ.cpp:116-239, maat.cpp:29-174).
+- ``on_commit`` / ``on_abort`` — CC metadata updates at txn end (the analog
+  of row_t::return_row write-back/rollback, storage/row.cpp:351-420).
+- ``on_start`` — per-txn CC state init at (re)admission (the analog of
+  process_rtxn's per-CC_ALG blocks, worker_thread.cpp:492-508).
+
+All hooks are pure: (cfg, db, txn, mask) -> updated arrays.  ``db`` is a flat
+dict of device arrays holding both per-row CC state (wts/rts, version rings)
+and per-txn-slot CC state (OCC read snapshots, MaaT bounds).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from deneva_tpu.config import Config
+from deneva_tpu.engine.state import TxnState
+
+
+class AccessDecision(NamedTuple):
+    """Per-txn outcome for this tick's current access; masks are (B,) and
+    mutually exclusive, valid only where the engine marked the txn active
+    with an outstanding request."""
+
+    grant: jnp.ndarray
+    wait: jnp.ndarray
+    abort: jnp.ndarray
+
+
+class CCPlugin:
+    name: str = "?"
+    #: reference worker_thread.cpp:492-495 — TIMESTAMP/MVCC (and OCC's
+    #: start_ts) re-draw a timestamp on every restart; WAIT_DIE keeps its
+    #: first timestamp forever (assigned only in the CL_QRY branch).
+    new_ts_on_restart: bool = False
+
+    def init_db(self, cfg: Config, n_rows: int, B: int, R: int) -> dict:
+        return {}
+
+    def on_start(self, cfg: Config, db: dict, txn: TxnState,
+                 started: jnp.ndarray) -> dict:
+        return db
+
+    def access(self, cfg: Config, db: dict, txn: TxnState,
+               active: jnp.ndarray) -> tuple[AccessDecision, dict]:
+        raise NotImplementedError
+
+    def validate(self, cfg: Config, db: dict, txn: TxnState,
+                 finishing: jnp.ndarray) -> tuple[jnp.ndarray, dict]:
+        return finishing, db
+
+    def on_commit(self, cfg: Config, db: dict, txn: TxnState,
+                  committed: jnp.ndarray, commit_ts: jnp.ndarray) -> dict:
+        return db
+
+    def on_abort(self, cfg: Config, db: dict, txn: TxnState,
+                 aborted: jnp.ndarray) -> dict:
+        return db

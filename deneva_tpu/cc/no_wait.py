@@ -1,0 +1,68 @@
+"""2PL lock-family plugins: NO_WAIT and WAIT_DIE.
+
+NO_WAIT: lock conflict => immediate abort (row_lock.cpp:86-90).
+WAIT_DIE: older txns wait, younger die (row_lock.cpp:91-151); timestamps
+assigned once at first start (worker_thread.cpp:478-480).
+
+Isolation levels (reference config.h:336-340; release-early hooks
+ycsb_txn.cpp:233-251):
+- SERIALIZABLE: strict 2PL, all locks to commit.
+- READ_COMMITTED: S locks released right after the read => completed read
+  accesses are not "held" entries.
+- READ_UNCOMMITTED: reads take no lock at all => read requests bypass
+  arbitration and always grant.
+- NOLOCK: CC disabled entirely (storage/row.cpp:199-206).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from deneva_tpu.cc.base import AccessDecision, CCPlugin
+from deneva_tpu.cc import twopl
+from deneva_tpu.config import Config, READ_UNCOMMITTED, READ_COMMITTED, NOLOCK
+from deneva_tpu.engine.state import TxnState, make_entries, NULL_KEY
+
+
+def _current_is_read(txn: TxnState) -> jnp.ndarray:
+    cur = jnp.minimum(txn.cursor, txn.R - 1)[:, None]
+    return ~jnp.take_along_axis(txn.is_write, cur, axis=1)[:, 0]
+
+
+class TwoPLPlugin(CCPlugin):
+    policy = "NO_WAIT"
+
+    def access(self, cfg: Config, db: dict, txn: TxnState, active):
+        has_req = active & (txn.cursor < txn.n_req)
+        z = jnp.zeros_like(has_req)
+
+        if cfg.isolation_level == NOLOCK:
+            return AccessDecision(grant=has_req, wait=z, abort=z), db
+
+        ent = make_entries(
+            txn, active,
+            read_locks_held=(cfg.isolation_level not in (READ_COMMITTED,
+                                                         READ_UNCOMMITTED)))
+        bypass = z
+        if cfg.isolation_level == READ_UNCOMMITTED:
+            # reads lock nothing: drop read requests from arbitration
+            drop = ent.req & ~ent.is_write
+            ent = ent._replace(key=jnp.where(drop, NULL_KEY, ent.key),
+                               req=ent.req & ~drop)
+            bypass = has_req & _current_is_read(txn)
+
+        g, w, a = twopl.arbitrate(ent, self.policy)
+        gt, wt, at_ = twopl.decisions_per_txn(ent, g, w, a, txn.B)
+        return AccessDecision(grant=gt | bypass, wait=wt & ~bypass,
+                              abort=at_ & ~bypass), db
+
+
+class NoWait(TwoPLPlugin):
+    name = "NO_WAIT"
+    policy = "NO_WAIT"
+
+
+class WaitDie(TwoPLPlugin):
+    name = "WAIT_DIE"
+    policy = "WAIT_DIE"
+    new_ts_on_restart = False

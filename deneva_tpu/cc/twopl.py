@@ -1,0 +1,92 @@
+"""Batched two-phase-locking arbitration (NO_WAIT / WAIT_DIE / CALVIN locks).
+
+Replaces the reference's per-row mutex + owner/waiter pointer lists
+(concurrency_control/row_lock.cpp:52-217) with one sorted join per tick:
+
+  sort all live lock entries by (row_key, held-before-request, priority)
+  and resolve grants with prefix reductions inside each row segment.
+
+Tick semantics (the batched reformulation of sequential arrival order):
+requests on a row are processed as if they arrived in priority (timestamp)
+order, after all currently-held locks.  A request is granted iff it is
+compatible with every lock that is held or granted earlier in that order:
+
+  grant(read)  = no write lock held-or-granted earlier in my row segment
+  grant(write) = I am the very first entry in my row segment
+
+On failure the per-algorithm rules of row_lock.cpp apply:
+
+- NO_WAIT  — abort immediately (row_lock.cpp:86-90).
+- WAIT_DIE — wait iff older than every current owner (requester ts < all
+  owner ts, row_lock.cpp:91-151); because requests are processed in ts
+  order, any granted request earlier in my segment is older than me, so
+  canwait reduces to: no granted request before me AND ts < min held ts.
+- CALVIN   — FIFO, never aborts: priority is the sequence number, a failed
+  entry blocks everything behind it (conflict if any waiter exists,
+  row_lock.cpp:78-81,152-170), so grant requires *no write entry at all*
+  earlier in the segment (failed or not).
+
+Waiters hold no explicit queue: a WAITING txn re-submits the same request
+with the same priority next tick, which reproduces the priority-ordered
+waiter list of the reference (waiters kept in ts order, row_lock.cpp:134-141).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from deneva_tpu.engine.state import Entries, BIG_TS, NULL_KEY
+from deneva_tpu.ops import segment as seg
+
+
+def arbitrate(ent: Entries, policy: str):
+    """Resolve this tick's lock requests.
+
+    Returns (grant, wait, abort): (B*R,)-shaped masks in *original entry
+    order*, true only at request positions.
+    """
+    n = ent.key.shape[0]
+    kind = jnp.where(ent.held, 0, 1).astype(jnp.int32)  # held sorts first
+    (skey, _, sts), (s_iw, s_held, s_req, s_orig) = seg.sort_by(
+        (ent.key, kind, ent.ts),
+        (ent.is_write, ent.held, ent.req, jnp.arange(n, dtype=jnp.int32)),
+    )
+    starts = seg.segment_starts(skey)
+    pos = seg.pos_in_segment(starts)
+    live = skey != NULL_KEY
+
+    if policy == "CALVIN":
+        # FIFO: any write earlier in the segment (granted or not) blocks.
+        any_w_before = seg.seg_any_before(s_iw & live, starts)
+        s_grant = s_req & jnp.where(s_iw, pos == 0, ~any_w_before)
+        s_wait = s_req & ~s_grant
+        s_abort = jnp.zeros_like(s_grant)
+    else:
+        # A write only ever takes effect at segment position 0; a held X lock
+        # is also necessarily at position 0 (exclusive => sole live entry
+        # apart from this tick's requests).  So "conflicting lock earlier in
+        # order" == "a write at pos 0 or a held write before me".
+        eff_w_before = seg.seg_any_before(s_iw & live & (s_held | (pos == 0)), starts)
+        s_grant = s_req & jnp.where(s_iw, pos == 0, ~eff_w_before)
+        s_fail = s_req & ~s_grant
+        if policy == "NO_WAIT":
+            s_wait = jnp.zeros_like(s_fail)
+            s_abort = s_fail
+        elif policy == "WAIT_DIE":
+            granted_before = seg.seg_any_before(s_grant, starts)
+            min_held_ts = seg.seg_min_where(sts, s_held, starts, BIG_TS)
+            canwait = ~granted_before & (sts < min_held_ts)
+            s_wait = s_fail & canwait
+            s_abort = s_fail & ~canwait
+        else:  # pragma: no cover
+            raise ValueError(policy)
+
+    # scatter back to original entry order
+    unsort = lambda x: jnp.zeros_like(x).at[s_orig].set(x)
+    return unsort(s_grant), unsort(s_wait), unsort(s_abort)
+
+
+def decisions_per_txn(ent: Entries, grant, wait, abort, B: int):
+    """Reduce per-entry request decisions to per-txn masks (one request/txn)."""
+    to_txn = lambda m: jnp.zeros(B, dtype=bool).at[ent.txn].max(m & ent.req)
+    return to_txn(grant), to_txn(wait), to_txn(abort)

@@ -1,0 +1,128 @@
+"""Runtime configuration.
+
+The reference drives everything from compile-time ``#define`` switches in
+``config.h`` (CC_ALG at config.h:101, WORKLOAD at config.h:40) plus ``g_*``
+globals overridable by a positional CLI parser (system/parser.cpp:76).  The
+TPU rebuild collapses all three tiers into one runtime dataclass; the CC_ALG
+switch becomes a registry of kernel implementations (deneva_tpu.cc.REGISTRY).
+
+Field names keep the reference's vocabulary (req_per_query, zipf_theta,
+part_per_txn, ...) so experiment configs translate one-to-one.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+# CC algorithms (reference config.h:94-101)
+NO_WAIT = "NO_WAIT"
+WAIT_DIE = "WAIT_DIE"
+TIMESTAMP = "TIMESTAMP"
+MVCC = "MVCC"
+OCC = "OCC"
+MAAT = "MAAT"
+CALVIN = "CALVIN"
+CC_ALGS = (NO_WAIT, WAIT_DIE, TIMESTAMP, MVCC, OCC, MAAT, CALVIN)
+
+# Workloads (reference config.h:40)
+YCSB = "YCSB"
+TPCC = "TPCC"
+PPS = "PPS"
+WORKLOADS = (YCSB, TPCC, PPS)
+
+# Isolation levels (reference config.h:336-340)
+SERIALIZABLE = "SERIALIZABLE"
+READ_COMMITTED = "READ_COMMITTED"
+READ_UNCOMMITTED = "READ_UNCOMMITTED"
+NOLOCK = "NOLOCK"
+ISOLATION_LEVELS = (SERIALIZABLE, READ_COMMITTED, READ_UNCOMMITTED, NOLOCK)
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    """One experiment cell: (CC_ALG x WORKLOAD x knobs).
+
+    Matches the knobs the reference's experiment harness sweeps
+    (scripts/experiments.py:345-407 rewrites config.h from these).
+    """
+
+    # --- topology (reference config.h:5-10) ---
+    node_cnt: int = 1            # NODE_CNT: server shards (chips / mesh size)
+    part_cnt: int = 1            # PART_CNT: logical partitions (== node_cnt here)
+    # THREAD_CNT has no analog: intra-node parallelism is the batch dimension.
+
+    # --- workload selection ---
+    workload: str = YCSB
+    cc_alg: str = NO_WAIT
+    isolation_level: str = SERIALIZABLE
+
+    # --- scheduler / batch engine (replaces MAX_TXN_IN_FLIGHT + worker loop) ---
+    batch_size: int = 4096       # concurrent in-flight txns per node (B)
+    max_ticks: int = 1_000_000   # safety bound on scheduler ticks per run
+    warmup_ticks: int = 0        # stats gated like is_warmup_done() (config.h:349)
+
+    # --- abort/backoff (reference config.h:112-114 ABORT_PENALTY/BACKOFF) ---
+    abort_penalty_ticks: int = 1
+    abort_penalty_max_ticks: int = 64
+    backoff: bool = True         # exponential backoff on repeated aborts
+    restart_new_ts: bool = False # reference re-reads ts only for new txns
+
+    # --- YCSB (reference config.h:216-233) ---
+    synth_table_size: int = 1 << 14   # SYNTH_TABLE_SIZE (16M/node in paper runs)
+    req_per_query: int = 10           # REQ_PER_QUERY
+    tup_read_perc: float = 0.5        # TUP_READ_PERC (per-request read prob)
+    txn_read_perc: float = 0.0        # TXN_READ_PERC (whole-txn read-only prob)
+    zipf_theta: float = 0.6           # ZIPF_THETA
+    part_per_txn: int = 1             # PART_PER_TXN
+    mpr: float = 0.0                  # MPR: multi-partition txn rate
+    first_part_local: bool = True     # FIRST_PART_LOCAL
+    strict_ppt: bool = False          # STRICT_PPT
+    key_order: bool = False           # KEY_ORDER: sort requests by key
+
+    # --- TPC-C (reference config.h:244-260) ---
+    num_wh: int = 4                   # NUM_WH
+    perc_payment: float = 0.5         # PERC_PAYMENT
+    wh_update: bool = True            # WH_UPDATE: payment updates warehouse row
+    dist_per_wh: int = 10
+    cust_per_dist: int = 2000         # CUST_PER_DIST (100k in full scale)
+    max_items: int = 1024             # MAXIMUM ITEMS (100k full scale)
+    tpcc_by_last_name_perc: float = 0.0  # secondary-index path (off: by id)
+
+    # --- PPS (reference config.h:235-242) ---
+    max_parts_per: int = 10
+    max_part_key: int = 1024
+    max_product_key: int = 1024
+    max_supplier_key: int = 1024
+
+    # --- T/O family ---
+    ts_twr: bool = False              # TS_TWR Thomas write rule (config.h:123)
+    his_recycle_len: int = 8          # HIS_RECYCLE_LEN: MVCC version-ring slots
+
+    # --- Calvin (reference config.h:348 SEQ_BATCH_TIMER) ---
+    seq_batch_size: Optional[int] = None  # txns per epoch (None -> batch_size)
+
+    # --- multi-shard routing ---
+    route_capacity_factor: float = 2.0  # per-(src,dst) all_to_all capacity slack
+
+    # --- run protocol (reference config.h:349-350: 60s warmup + 60s run) ---
+    seed: int = 12345
+    query_pool_size: int = 1 << 16    # pre-generated queries (client_query.cpp:30)
+
+    def __post_init__(self):
+        assert self.cc_alg in CC_ALGS, self.cc_alg
+        assert self.workload in WORKLOADS, self.workload
+        assert self.isolation_level in ISOLATION_LEVELS
+        assert self.part_cnt >= self.node_cnt and self.part_cnt % self.node_cnt == 0
+        assert self.synth_table_size % self.part_cnt == 0
+
+    @property
+    def rows_per_part(self) -> int:
+        return self.synth_table_size // self.part_cnt
+
+    @property
+    def epoch_size(self) -> int:
+        return self.seq_batch_size if self.seq_batch_size is not None else self.batch_size
+
+    def replace(self, **kw) -> "Config":
+        return dataclasses.replace(self, **kw)

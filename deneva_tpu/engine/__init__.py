@@ -1,0 +1,7 @@
+from deneva_tpu.engine.state import TxnState, Entries, STATUS_FREE, STATUS_RUNNING, STATUS_WAITING, STATUS_BACKOFF
+from deneva_tpu.engine.scheduler import Engine
+
+__all__ = [
+    "TxnState", "Entries", "Engine",
+    "STATUS_FREE", "STATUS_RUNNING", "STATUS_WAITING", "STATUS_BACKOFF",
+]

@@ -1,0 +1,266 @@
+"""The batched scheduler tick — rebuild of the reference's worker loop.
+
+One tick performs, for ALL in-flight transactions at once, what the
+reference's WorkerThread::run dequeue loop (system/worker_thread.cpp:183-275)
+does one message at a time:
+
+  1. wake aborted txns whose backoff penalty expired
+     (AbortQueue::process, system/abort_queue.cpp:26-82);
+  2. admit new txns into free slots from the pre-generated query pool
+     (process_rtxn + Client_query_queue, worker_thread.cpp:460-517);
+  3. finish txns that completed their access program: CC validation,
+     commit bookkeeping and write application
+     (start_commit/commit path, system/txn.cpp:487-554);
+  4. run the CC access kernel for every txn's current access
+     (run_txn state machine + row_t::get_row, benchmarks/ycsb_txn.cpp:177);
+  5. process aborts: exponential backoff re-queue
+     (WorkerThread::abort, worker_thread.cpp:160-171).
+
+The whole tick is one jit'd pure function (EngineState -> EngineState); stats
+live in the carry as device scalars (the tensorized Stats_thd).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deneva_tpu import cc as cc_registry
+from deneva_tpu.config import Config, YCSB
+from deneva_tpu.engine.state import (
+    STATUS_BACKOFF, STATUS_FREE, STATUS_RUNNING, STATUS_WAITING,
+    TxnState,
+)
+from deneva_tpu.workloads import ycsb
+from deneva_tpu.workloads.base import QueryPool
+
+
+class EngineState(NamedTuple):
+    txn: TxnState
+    db: dict                  # CC-plugin arrays (per-row and per-slot)
+    data: jnp.ndarray         # (n_rows,) int32 — row payload (increment oracle)
+    stats: dict               # scalar counters
+    tick: jnp.ndarray         # int32 scalar
+    pool_cursor: jnp.ndarray  # int32 scalar
+    ts_counter: jnp.ndarray   # int32 scalar
+
+
+STAT_KEYS_I32 = (
+    "txn_cnt",                 # committed txns (stats.cpp tput numerator)
+    "total_txn_abort_cnt",     # abort events (txn.cpp:450)
+    "unique_txn_abort_cnt",    # txns that aborted >= once
+    "local_txn_start_cnt",     # admissions
+    "twopl_wait_cnt",          # WAIT decisions (parked continuations)
+    "write_cnt",               # committed write accesses applied
+    "measured_ticks",          # post-warmup ticks elapsed
+)
+STAT_KEYS_F32 = (
+    "txn_run_time_ticks",      # sum of short latency (last restart -> commit)
+    "txn_total_time_ticks",    # sum of long latency (first start -> commit)
+)
+
+
+def _zeros_stats() -> dict:
+    s = {k: jnp.zeros((), jnp.int32) for k in STAT_KEYS_I32}
+    s.update({k: jnp.zeros((), jnp.float32) for k in STAT_KEYS_F32})
+    return s
+
+
+def _pool_to_device(pool: QueryPool) -> dict:
+    return {
+        "keys": jnp.asarray(pool.keys),
+        "is_write": jnp.asarray(pool.is_write),
+        "n_req": jnp.asarray(pool.n_req),
+    }
+
+
+def make_tick(cfg: Config, plugin, pool_dev: dict):
+    Q = pool_dev["keys"].shape[0]
+
+    def bump(stats, key, amount, measuring):
+        inc = jnp.where(measuring, amount, 0).astype(stats[key].dtype)
+        return {**stats, key: stats[key] + inc}
+
+    def tick_fn(state: EngineState) -> EngineState:
+        txn, db, data, stats = state.txn, state.db, state.data, state.stats
+        t = state.tick
+        measuring = t >= cfg.warmup_ticks
+
+        # ---- 1. backoff expiry: restart aborted txns ----
+        expire = (txn.status == STATUS_BACKOFF) & (txn.backoff_until <= t)
+        status = jnp.where(expire, STATUS_RUNNING, txn.status)
+        start_tick = jnp.where(expire, t, txn.start_tick)
+
+        # ---- 2. admission from query pool ----
+        free = status == STATUS_FREE
+        frank = jnp.cumsum(free.astype(jnp.int32)) - free.astype(jnp.int32)
+        n_free = jnp.sum(free.astype(jnp.int32))
+        pidx = (state.pool_cursor + frank) % Q
+
+        keys = jnp.where(free[:, None], pool_dev["keys"][pidx], txn.keys)
+        is_write = jnp.where(free[:, None], pool_dev["is_write"][pidx], txn.is_write)
+        n_req = jnp.where(free, pool_dev["n_req"][pidx], txn.n_req)
+
+        # timestamp allocation: fresh txns always; restarted txns iff the CC
+        # algorithm re-draws per attempt (worker_thread.cpp:492-495)
+        redraw = plugin.new_ts_on_restart or cfg.restart_new_ts
+        need_ts = free | (expire if redraw else jnp.zeros_like(free))
+        trank = jnp.cumsum(need_ts.astype(jnp.int32)) - need_ts.astype(jnp.int32)
+        ts = jnp.where(need_ts, state.ts_counter + trank, txn.ts)
+        ts_counter = state.ts_counter + jnp.sum(need_ts.astype(jnp.int32))
+
+        status = jnp.where(free, STATUS_RUNNING, status)
+        cursor = jnp.where(free, 0, txn.cursor)
+        restarts = jnp.where(free, 0, txn.restarts)
+        pool_idx = jnp.where(free, pidx, txn.pool_idx)
+        start_tick = jnp.where(free, t, start_tick)
+        first_start_tick = jnp.where(free, t, txn.first_start_tick)
+        stats = bump(stats, "local_txn_start_cnt", n_free, measuring)
+
+        txn = TxnState(status=status, cursor=cursor, ts=ts, pool_idx=pool_idx,
+                       restarts=restarts, backoff_until=txn.backoff_until,
+                       start_tick=start_tick, first_start_tick=first_start_tick,
+                       keys=keys, is_write=is_write, n_req=n_req)
+        db = plugin.on_start(cfg, db, txn, free | expire)
+
+        # ---- 3. commit phase ----
+        finishing = (txn.status == STATUS_RUNNING) & (txn.cursor >= txn.n_req)
+        ok, db = plugin.validate(cfg, db, txn, finishing)
+        commit = finishing & ok
+        vabort = finishing & ~ok
+        db = plugin.on_commit(cfg, db, txn, commit, commit_ts=txn.ts)
+
+        ridx = jnp.arange(txn.R, dtype=jnp.int32)[None, :]
+        wmask = commit[:, None] & txn.is_write & (ridx < txn.n_req[:, None])
+        data = data.at[txn.keys.reshape(-1)].add(
+            wmask.reshape(-1).astype(jnp.int32), mode="drop")
+
+        n_commit = jnp.sum(commit.astype(jnp.int32))
+        stats = bump(stats, "txn_cnt", n_commit, measuring)
+        stats = bump(stats, "write_cnt",
+                     jnp.sum(wmask.astype(jnp.int32)), measuring)
+        stats = bump(stats, "unique_txn_abort_cnt",
+                     jnp.sum((commit & (txn.restarts > 0)).astype(jnp.int32)),
+                     measuring)
+        stats = bump(stats, "txn_run_time_ticks",
+                     jnp.sum(jnp.where(commit, t - txn.start_tick, 0)), measuring)
+        stats = bump(stats, "txn_total_time_ticks",
+                     jnp.sum(jnp.where(commit, t - txn.first_start_tick, 0)),
+                     measuring)
+
+        status = jnp.where(commit, STATUS_FREE, txn.status)
+        txn = txn._replace(status=status)
+
+        # ---- 4. access phase ----
+        active = ((txn.status == STATUS_RUNNING) | (txn.status == STATUS_WAITING)) \
+            & ~vabort
+        has_req = active & (txn.cursor < txn.n_req)
+        dec, db = plugin.access(cfg, db, txn, active)
+        grant = dec.grant & has_req
+        wait = dec.wait & has_req
+        abort_now = (dec.abort & has_req) | vabort
+
+        cursor = jnp.where(grant, txn.cursor + 1, txn.cursor)
+        status = jnp.where(grant, STATUS_RUNNING,
+                  jnp.where(wait, STATUS_WAITING, txn.status))
+        stats = bump(stats, "twopl_wait_cnt",
+                     jnp.sum(wait.astype(jnp.int32)), measuring)
+
+        # ---- 5. abort processing: exponential backoff ----
+        stats = bump(stats, "total_txn_abort_cnt",
+                     jnp.sum(abort_now.astype(jnp.int32)), measuring)
+        shift = jnp.minimum(txn.restarts, 16)
+        penalty = jnp.where(
+            jnp.asarray(cfg.backoff),
+            jnp.minimum(cfg.abort_penalty_ticks * (1 << shift),
+                        cfg.abort_penalty_max_ticks),
+            cfg.abort_penalty_ticks).astype(jnp.int32)
+        status = jnp.where(abort_now, STATUS_BACKOFF, status)
+        cursor = jnp.where(abort_now, 0, cursor)
+        backoff_until = jnp.where(abort_now, t + penalty, txn.backoff_until)
+        restarts2 = jnp.where(abort_now, txn.restarts + 1, txn.restarts)
+        txn = txn._replace(status=status, cursor=cursor,
+                           backoff_until=backoff_until, restarts=restarts2)
+        db = plugin.on_abort(cfg, db, txn, abort_now)
+
+        # ts wraparound guard: only relative order matters, and every live
+        # txn's ts lies within [ts_counter - horizon, ts_counter], so rebase
+        # all timestamps periodically instead of letting int32 overflow
+        # (at ~1M admissions/s int32 would wrap in ~35 min of simulation)
+        REBASE_AT, REBASE_BY = jnp.int32(3 << 29), jnp.int32(1 << 30)
+        do_rebase = ts_counter > REBASE_AT
+        shift_ts = jnp.where(do_rebase, REBASE_BY, 0)
+        txn = txn._replace(ts=jnp.maximum(txn.ts - shift_ts, 1))
+        ts_counter = ts_counter - shift_ts
+
+        stats = bump(stats, "measured_ticks", 1, measuring)
+        return EngineState(txn=txn, db=db, data=data, stats=stats,
+                           tick=t + 1, pool_cursor=(state.pool_cursor + n_free) % Q,
+                           ts_counter=ts_counter)
+
+    return tick_fn
+
+
+class Engine:
+    """Single-shard scheduler. Multi-shard wraps this tick in shard_map."""
+
+    def __init__(self, cfg: Config, pool: QueryPool | None = None):
+        self.cfg = cfg
+        self.plugin = cc_registry.get(cfg.cc_alg)
+        if pool is None:
+            if cfg.workload != YCSB:
+                raise NotImplementedError(cfg.workload)
+            pool = ycsb.gen_query_pool(cfg)
+        self.pool = pool
+        self.pool_dev = _pool_to_device(pool)
+        self._tick_fn = make_tick(cfg, self.plugin, self.pool_dev)
+        self._tick_jit = jax.jit(self._tick_fn, donate_argnums=0)
+
+    def init_state(self) -> EngineState:
+        cfg = self.cfg
+        B, R = cfg.batch_size, self.pool.max_req
+        return EngineState(
+            txn=TxnState.empty(B, R),
+            db=self.plugin.init_db(cfg, cfg.synth_table_size, B, R),
+            data=jnp.zeros(cfg.synth_table_size, jnp.int32),
+            stats=_zeros_stats(),
+            tick=jnp.zeros((), jnp.int32),
+            pool_cursor=jnp.zeros((), jnp.int32),
+            ts_counter=jnp.ones((), jnp.int32),
+        )
+
+    def run(self, n_ticks: int, state: EngineState | None = None) -> EngineState:
+        if state is None:
+            state = self.init_state()
+        for _ in range(n_ticks):
+            state = self._tick_jit(state)
+        return state
+
+    @functools.partial(jax.jit, static_argnums=(0, 1), donate_argnums=2)
+    def _run_scan(self, n_ticks: int, state: EngineState) -> EngineState:
+        return jax.lax.fori_loop(0, n_ticks, lambda _, s: self._tick_fn(s), state)
+
+    def run_compiled(self, n_ticks: int, state: EngineState | None = None) -> EngineState:
+        """Fully device-side run: n_ticks in one lax.fori_loop under jit."""
+        if state is None:
+            state = self.init_state()
+        return self._run_scan(n_ticks, state)
+
+    def summary(self, state: EngineState, wall_seconds: float | None = None) -> dict:
+        """Host-side stats in the reference's [summary] vocabulary
+        (statistics/stats.cpp:1541-1575)."""
+        s = {k: np.asarray(v).item() for k, v in state.stats.items()}
+        commits = max(s["txn_cnt"], 1)
+        out = dict(s)
+        out["tput_per_tick"] = s["txn_cnt"] / max(s["measured_ticks"], 1)
+        out["abort_rate"] = s["total_txn_abort_cnt"] / (
+            s["total_txn_abort_cnt"] + commits)
+        out["avg_latency_ticks_short"] = s["txn_run_time_ticks"] / commits
+        out["avg_latency_ticks_long"] = s["txn_total_time_ticks"] / commits
+        if wall_seconds is not None:
+            out["tput"] = s["txn_cnt"] / wall_seconds
+        return out

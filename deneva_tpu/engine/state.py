@@ -1,0 +1,111 @@
+"""Device-resident scheduler state.
+
+This is the tensorized equivalent of the reference's TxnTable + work queue +
+abort queue (system/txn_table.cpp, system/work_queue.cpp, system/abort_queue.cpp):
+
+- one fixed-size slot per in-flight transaction (B = MAX_TXN_IN_FLIGHT);
+- the work queue disappears — every active txn advances each tick;
+- the abort queue becomes a per-slot ``backoff_until`` tick;
+- parked/waiting txns (lock_ready=false continuations, txn_table.restart_txn)
+  become slots in STATUS_WAITING that simply re-arbitrate every tick.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+# txn slot status (the tensorized txn state machine)
+STATUS_FREE = 0      # slot empty, admissible
+STATUS_RUNNING = 1   # executing its access program
+STATUS_WAITING = 2   # current access blocked; retries each tick (WAIT rc)
+STATUS_BACKOFF = 3   # aborted, sleeping out its abort penalty
+
+BIG_TS = np.int32(2**31 - 1)
+NULL_KEY = np.int32(2**31 - 1)  # sort sentinel: dead entries sort last
+
+
+class TxnState(NamedTuple):
+    """Per-slot transaction state, all shape (B,) or (B, R)."""
+
+    status: jnp.ndarray        # (B,) int32
+    cursor: jnp.ndarray        # (B,) int32: index of current access
+    ts: jnp.ndarray            # (B,) int32: timestamp / priority
+    pool_idx: jnp.ndarray      # (B,) int32
+    restarts: jnp.ndarray      # (B,) int32
+    backoff_until: jnp.ndarray # (B,) int32 tick
+    start_tick: jnp.ndarray    # (B,) int32: latest (re)start
+    first_start_tick: jnp.ndarray  # (B,) int32: first start (long latency)
+    keys: jnp.ndarray          # (B, R) int32
+    is_write: jnp.ndarray      # (B, R) bool
+    n_req: jnp.ndarray         # (B,) int32
+
+    @property
+    def B(self) -> int:
+        return self.status.shape[0]
+
+    @property
+    def R(self) -> int:
+        return self.keys.shape[1]
+
+    @staticmethod
+    def empty(B: int, R: int) -> "TxnState":
+        # distinct buffers per field: the tick donates its argument, and XLA
+        # rejects donating one buffer twice
+        zi = lambda: jnp.zeros(B, dtype=jnp.int32)
+        return TxnState(
+            status=zi(), cursor=zi(), ts=zi(), pool_idx=zi(), restarts=zi(),
+            backoff_until=zi(), start_tick=zi(), first_start_tick=zi(),
+            keys=jnp.full((B, R), NULL_KEY, dtype=jnp.int32),
+            is_write=jnp.zeros((B, R), dtype=bool),
+            n_req=zi(),
+        )
+
+
+class Entries(NamedTuple):
+    """Flattened (B*R) view of all access entries + liveness masks.
+
+    ``held``  — lock currently held (2PL) / access already performed.
+    ``req``   — the access the txn is trying to perform this tick.
+    Entry priority is the owning txn's ts; ``txn`` is the slot index.
+    """
+
+    key: jnp.ndarray       # (B*R,) int32, NULL_KEY where dead
+    txn: jnp.ndarray       # (B*R,) int32
+    ridx: jnp.ndarray      # (B*R,) int32: access index within txn
+    ts: jnp.ndarray        # (B*R,) int32
+    is_write: jnp.ndarray  # (B*R,) bool
+    held: jnp.ndarray      # (B*R,) bool
+    req: jnp.ndarray       # (B*R,) bool
+
+
+def make_entries(txn: TxnState, active: jnp.ndarray,
+                 read_locks_held: bool = True) -> Entries:
+    """Build the live entry view for lock-style arbitration.
+
+    ``active``: (B,) mask of txns participating (RUNNING | WAITING).
+    ``read_locks_held``: False under READ_COMMITTED — S-locks release
+    immediately after the read (reference config.h:336-340, txn.cpp:707-728),
+    so completed read accesses are not held entries.
+    """
+    B, R = txn.keys.shape
+    ridx = jnp.broadcast_to(jnp.arange(R, dtype=jnp.int32), (B, R))
+    cur = txn.cursor[:, None]
+    act = active[:, None]
+    held = act & (ridx < cur)
+    if not read_locks_held:
+        held = held & txn.is_write
+    req = act & (ridx == cur) & (cur < txn.n_req[:, None])
+    live = held | req
+    flat = lambda x: x.reshape(-1)
+    return Entries(
+        key=flat(jnp.where(live, txn.keys, NULL_KEY)),
+        txn=flat(jnp.broadcast_to(jnp.arange(B, dtype=jnp.int32)[:, None], (B, R))),
+        ridx=flat(ridx),
+        ts=flat(jnp.broadcast_to(txn.ts[:, None], (B, R))),
+        is_write=flat(txn.is_write),
+        held=flat(held),
+        req=flat(req),
+    )

@@ -1,0 +1,3 @@
+from deneva_tpu.ops import segment
+
+__all__ = ["segment"]

@@ -1,0 +1,4 @@
+from deneva_tpu.workloads.base import QueryPool
+from deneva_tpu.workloads import ycsb
+
+__all__ = ["QueryPool", "ycsb"]

@@ -1,0 +1,43 @@
+"""Workload-independent query-pool container.
+
+The reference pre-generates every client query before the run starts
+(client/client_query.cpp:30-121, ``Client_query_queue``) and the client
+threads replay them open-loop.  The rebuild keeps that architecture: workload
+generators run host-side (numpy) and produce dense tensors the device engine
+consumes by cursor; the pool wraps around when exhausted, like the reference's
+index wraparound.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class QueryPool:
+    """A pool of Q pre-generated transactions, each with up to R accesses.
+
+    keys      (Q, R) int32  — global primary keys (padded with -1)
+    is_write  (Q, R) bool
+    n_req     (Q,)   int32  — number of valid accesses
+    home_part (Q,)   int32  — partition of the client/home node
+    txn_type  (Q,)   int32  — workload-specific program id (0 for YCSB)
+    args      (Q, A) int32  — workload-specific scalar args (TPC-C amounts etc.)
+    """
+
+    keys: np.ndarray
+    is_write: np.ndarray
+    n_req: np.ndarray
+    home_part: np.ndarray
+    txn_type: np.ndarray
+    args: np.ndarray
+
+    @property
+    def size(self) -> int:
+        return self.keys.shape[0]
+
+    @property
+    def max_req(self) -> int:
+        return self.keys.shape[1]

@@ -1,0 +1,19 @@
+"""Test fixture: run everything on a virtual 8-device CPU platform.
+
+This is the rebuild's analog of the reference's TPORT_TYPE=IPC local mode
+(transport.cpp:132-133, experiments.py:362): multi-node behavior exercised on
+a single host.  NODE_CNT>1 shardings run on 8 virtual CPU devices via
+--xla_force_host_platform_device_count, per SURVEY.md §4.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+os.environ["JAX_PLATFORMS"] = "cpu"  # the session presets an axon/tpu platform
+
+import jax  # noqa: E402
+
+# the env var alone does not beat the preinstalled tpu plugin's priority
+jax.config.update("jax_platforms", "cpu")

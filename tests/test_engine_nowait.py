@@ -1,0 +1,138 @@
+"""End-to-end NO_WAIT engine tests: golden micro-schedules + invariants."""
+
+import numpy as np
+import pytest
+
+from deneva_tpu.config import Config
+from deneva_tpu.engine.scheduler import Engine
+from deneva_tpu.workloads.base import QueryPool
+
+
+def make_pool(keys, is_write):
+    keys = np.asarray(keys, np.int32)
+    is_write = np.asarray(is_write, bool)
+    Q, R = keys.shape
+    return QueryPool(
+        keys=keys, is_write=is_write,
+        n_req=np.full(Q, R, np.int32),
+        home_part=np.zeros(Q, np.int32),
+        txn_type=np.zeros(Q, np.int32),
+        args=np.zeros((Q, 1), np.int32),
+    )
+
+
+def small_cfg(**kw):
+    base = dict(batch_size=4, synth_table_size=64, req_per_query=2,
+                query_pool_size=4, abort_penalty_ticks=1, backoff=False,
+                warmup_ticks=0, cc_alg="NO_WAIT")
+    base.update(kw)
+    return Config(**base)
+
+
+def test_conflict_free_txns_all_commit():
+    # 4 txns, disjoint keys: everyone proceeds in lockstep, commits after
+    # R grant ticks + 1 commit tick.
+    keys = np.arange(8, dtype=np.int32).reshape(4, 2)
+    pool = make_pool(keys, np.ones((4, 2), bool))
+    eng = Engine(small_cfg(), pool=pool)
+    st = eng.run(4)  # t0: admit+first grant, t1: second grant, t2: commit
+    s = eng.summary(st)
+    assert s["txn_cnt"] == 4
+    assert s["total_txn_abort_cnt"] == 0
+    # increment oracle: every committed write applied exactly once
+    assert np.asarray(st.data).sum() == s["write_cnt"] == 8
+
+
+def test_ww_conflict_younger_aborts():
+    # txn0 and txn1 both write key 5 as their FIRST access; txn0 is admitted
+    # with the smaller ts => wins; txn1 must abort (NO_WAIT conflict rule).
+    keys = np.array([[5, 1], [5, 2], [10, 11], [12, 13]], np.int32)
+    pool = make_pool(keys, np.ones((4, 2), bool))
+    eng = Engine(small_cfg(), pool=pool)
+    st = eng.run(1)
+    txn = st.txn
+    # slot 0 granted (cursor 1), slot 1 aborted (backoff)
+    assert int(txn.cursor[0]) == 1
+    assert int(txn.status[1]) == 3  # STATUS_BACKOFF
+    assert int(txn.restarts[1]) == 1
+
+
+def test_rr_share_no_conflict():
+    # both txns READ key 5: shared lock, both proceed.
+    keys = np.array([[5, 1], [5, 2], [10, 11], [12, 13]], np.int32)
+    pool = make_pool(keys, np.zeros((4, 2), bool))
+    eng = Engine(small_cfg(), pool=pool)
+    st = eng.run(1)
+    assert int(st.txn.cursor[0]) == 1
+    assert int(st.txn.cursor[1]) == 1
+
+
+def test_rw_conflict_aborts_writer():
+    # txn0 reads key 5 (smaller ts), txn1 writes key 5 => writer aborts.
+    keys = np.array([[5, 1], [5, 2], [10, 11], [12, 13]], np.int32)
+    iw = np.array([[False, False], [True, True], [False, False], [False, False]])
+    pool = make_pool(keys, iw)
+    eng = Engine(small_cfg(), pool=pool)
+    st = eng.run(1)
+    assert int(st.txn.cursor[0]) == 1
+    assert int(st.txn.status[1]) == 3
+
+
+def test_aborted_txn_retries_and_commits():
+    # Two writers on the same key; loser backs off, retries once the winner
+    # committed, then commits.  Query pool has only these two txns (B=2).
+    keys = np.array([[5, 1], [5, 2]], np.int32)
+    pool = make_pool(keys, np.ones((2, 2), bool))
+    cfg = small_cfg(batch_size=2, query_pool_size=2)
+    eng = Engine(cfg, pool=pool)
+    st = eng.run(12)
+    s = eng.summary(st)
+    assert s["txn_cnt"] >= 4          # both slots keep committing (pool wraps)
+    assert s["total_txn_abort_cnt"] >= 1
+    # serializability oracle: data increments == committed writes
+    assert np.asarray(st.data).sum() == s["write_cnt"]
+
+
+@pytest.mark.parametrize("theta", [0.0, 0.9])
+def test_increment_oracle_under_contention(theta):
+    cfg = Config(batch_size=64, synth_table_size=256, req_per_query=4,
+                 query_pool_size=512, zipf_theta=theta, tup_read_perc=0.5,
+                 cc_alg="NO_WAIT", warmup_ticks=0)
+    eng = Engine(cfg)
+    st = eng.run(40)
+    s = eng.summary(st)
+    assert s["txn_cnt"] > 0
+    assert np.asarray(st.data).sum() == s["write_cnt"]
+    if theta == 0.9:
+        assert s["total_txn_abort_cnt"] > 0  # hot keys must conflict
+
+
+def test_read_only_never_aborts():
+    cfg = Config(batch_size=32, synth_table_size=256, req_per_query=4,
+                 query_pool_size=256, zipf_theta=0.9, txn_read_perc=1.0,
+                 cc_alg="NO_WAIT", warmup_ticks=0)
+    eng = Engine(cfg)
+    st = eng.run(30)
+    s = eng.summary(st)
+    assert s["total_txn_abort_cnt"] == 0
+    assert s["txn_cnt"] > 0
+    assert np.asarray(st.data).sum() == 0
+
+
+def test_warmup_gates_stats():
+    cfg = Config(batch_size=16, synth_table_size=256, req_per_query=2,
+                 query_pool_size=64, cc_alg="NO_WAIT", warmup_ticks=10)
+    eng = Engine(cfg)
+    st = eng.run(10)
+    assert eng.summary(st)["txn_cnt"] == 0  # still warming up
+    st = eng.run(20, st)
+    assert eng.summary(st)["txn_cnt"] > 0
+
+
+def test_run_compiled_matches_run():
+    cfg = Config(batch_size=32, synth_table_size=512, req_per_query=3,
+                 query_pool_size=128, zipf_theta=0.6, cc_alg="NO_WAIT")
+    eng = Engine(cfg)
+    s1 = eng.summary(eng.run(25))
+    s2 = eng.summary(eng.run_compiled(25))
+    assert s1 == s2

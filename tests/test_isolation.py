@@ -1,0 +1,61 @@
+"""Isolation levels (reference config.h:336-340; early-release hooks
+ycsb_txn.cpp:233-251, NOLOCK bypass storage/row.cpp:199-206)."""
+
+import numpy as np
+
+from deneva_tpu.engine.scheduler import Engine
+from deneva_tpu.engine.state import STATUS_BACKOFF
+from tests.test_engine_nowait import make_pool, small_cfg
+
+
+def test_read_committed_releases_read_locks():
+    # txn0 READS k5 then k1; txn1 WRITES k5 then k2.
+    # tick0: txn0 reads k5 (S), txn1's write of k5 conflicts -> aborts under
+    # SERIALIZABLE.  Under READ_COMMITTED the S lock is released right after
+    # the read, so at tick1 txn1's retry... but with no backoff txn1 aborts
+    # at tick0 either way (same-tick conflict).  Distinguish at tick1+:
+    # under RC txn0's completed read of k5 is NOT held, so txn1 (restarted)
+    # can take k5 while txn0 still runs.
+    keys = np.array([[5, 1], [5, 2]], np.int32)
+    iw = np.array([[False, False], [True, True]])
+    pool = make_pool(keys, iw)
+
+    # SERIALIZABLE: txn1 keeps dying while txn0 holds S(k5) (until commit)
+    eng = Engine(small_cfg(batch_size=2, query_pool_size=2,
+                           isolation_level="SERIALIZABLE"), pool=pool)
+    st = eng.run(1)
+    assert int(st.txn.status[1]) == STATUS_BACKOFF
+
+    # READ_COMMITTED: at tick1 txn1 restarts; txn0 holds only its *current*
+    # request (k1 read), S(k5) was dropped -> txn1 takes k5.
+    eng2 = Engine(small_cfg(batch_size=2, query_pool_size=2,
+                            isolation_level="READ_COMMITTED"), pool=pool)
+    st2 = eng2.run(2)
+    assert int(st2.txn.cursor[1]) == 1  # write of k5 granted on retry
+
+
+def test_read_uncommitted_reads_bypass_x_locks():
+    # txn0 WRITES k5 (X lock, long txn); txn1 READS k5.
+    keys = np.array([[5, 1], [5, 2]], np.int32)
+    iw = np.array([[True, True], [False, False]])
+    pool = make_pool(keys, iw)
+
+    eng = Engine(small_cfg(batch_size=2, query_pool_size=2,
+                           isolation_level="SERIALIZABLE"), pool=pool)
+    st = eng.run(1)
+    assert int(st.txn.status[1]) == STATUS_BACKOFF  # reader dies (NO_WAIT)
+
+    eng2 = Engine(small_cfg(batch_size=2, query_pool_size=2,
+                            isolation_level="READ_UNCOMMITTED"), pool=pool)
+    st2 = eng2.run(1)
+    assert int(st2.txn.cursor[1]) == 1  # read granted despite held X
+
+
+def test_nolock_never_conflicts():
+    keys = np.array([[5, 1], [5, 2], [5, 3], [5, 4]], np.int32)
+    pool = make_pool(keys, np.ones((4, 2), bool))
+    eng = Engine(small_cfg(isolation_level="NOLOCK"), pool=pool)
+    st = eng.run(3)
+    s = eng.summary(st)
+    assert s["total_txn_abort_cnt"] == 0
+    assert s["txn_cnt"] == 4

@@ -1,0 +1,51 @@
+"""Sorted-segment primitives vs straightforward numpy references."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from deneva_tpu.ops import segment as seg
+
+
+def _np_starts(ids):
+    return np.array([i == 0 or ids[i] != ids[i - 1] for i in range(len(ids))])
+
+
+def test_segment_starts_and_pos():
+    ids = jnp.array([3, 3, 5, 5, 5, 9, 11, 11])
+    starts = seg.segment_starts(ids)
+    np.testing.assert_array_equal(np.asarray(starts), _np_starts(np.asarray(ids)))
+    pos = seg.pos_in_segment(starts)
+    np.testing.assert_array_equal(np.asarray(pos), [0, 1, 0, 1, 2, 0, 0, 1])
+
+
+def test_seg_cumsum_exclusive_and_any_before():
+    ids = jnp.array([1, 1, 1, 4, 4, 7])
+    x = jnp.array([1, 0, 1, 1, 1, 1])
+    starts = seg.segment_starts(ids)
+    out = seg.seg_cumsum_exclusive(x, starts)
+    np.testing.assert_array_equal(np.asarray(out), [0, 1, 1, 0, 1, 0])
+    any_b = seg.seg_any_before(x.astype(bool), starts)
+    np.testing.assert_array_equal(np.asarray(any_b), [0, 1, 1, 0, 1, 0])
+
+
+def test_seg_reduce_and_min_where():
+    ids = jnp.array([0, 0, 2, 2, 2, 6])
+    vals = jnp.array([5, 3, 9, 1, 7, 4])
+    starts = seg.segment_starts(ids)
+    np.testing.assert_array_equal(
+        np.asarray(seg.seg_reduce(vals, starts, "min")), [3, 3, 1, 1, 1, 4])
+    np.testing.assert_array_equal(
+        np.asarray(seg.seg_reduce(vals, starts, "sum")), [8, 8, 17, 17, 17, 4])
+    where = jnp.array([True, False, False, True, True, False])
+    out = seg.seg_min_where(vals, where, starts, 99)
+    np.testing.assert_array_equal(np.asarray(out), [5, 5, 1, 1, 1, 99])
+
+
+def test_sort_by_lexicographic():
+    k1 = jnp.array([2, 1, 2, 1])
+    k2 = jnp.array([9, 8, 3, 7])
+    p = jnp.array([0, 1, 2, 3])
+    (s1, s2), (sp,) = seg.sort_by((k1, k2), (p,))
+    np.testing.assert_array_equal(np.asarray(s1), [1, 1, 2, 2])
+    np.testing.assert_array_equal(np.asarray(s2), [7, 8, 3, 9])
+    np.testing.assert_array_equal(np.asarray(sp), [3, 1, 2, 0])

@@ -1,0 +1,87 @@
+"""WAIT_DIE golden micro-schedules (semantics of row_lock.cpp:91-151):
+older txns wait for younger lock holders; younger txns die."""
+
+import numpy as np
+
+from deneva_tpu.config import Config
+from deneva_tpu.engine.scheduler import Engine
+from deneva_tpu.engine.state import STATUS_BACKOFF, STATUS_WAITING
+from tests.test_engine_nowait import make_pool, small_cfg
+
+
+def wd_cfg(**kw):
+    kw.setdefault("cc_alg", "WAIT_DIE")
+    return small_cfg(**kw)
+
+
+def test_older_waits_for_younger_holder():
+    # txn0 (older): [k1, k5];  txn1 (younger): [k5, k2] — all writes.
+    # tick0: txn0 takes k1, txn1 takes k5.
+    # tick1: txn0 wants k5 (held by younger txn1) -> WAIT; txn1 takes k2.
+    # tick2: txn1 finishes+commits, releasing k5 -> txn0 grabs it same tick.
+    keys = np.array([[1, 5], [5, 2]], np.int32)
+    pool = make_pool(keys, np.ones((2, 2), bool))
+    eng = Engine(wd_cfg(batch_size=2, query_pool_size=2), pool=pool)
+
+    st = eng.run(2)
+    assert int(st.txn.status[0]) == STATUS_WAITING
+    assert int(st.txn.cursor[0]) == 1
+    assert int(st.txn.cursor[1]) == 2
+
+    st = eng.run(1, st)
+    s = eng.summary(st)
+    assert s["txn_cnt"] == 1           # txn1 committed
+    assert int(st.txn.cursor[0]) == 2  # txn0 acquired k5 after release
+    assert s["total_txn_abort_cnt"] == 0
+
+    st = eng.run(1, st)
+    assert eng.summary(st)["txn_cnt"] == 2
+
+
+def test_younger_dies_on_older_holder():
+    # txn0 (older): [k5, k1]; txn1 (younger): [k2, k5].
+    # tick1: txn1 wants k5 held by OLDER txn0 -> die (ts1 > ts0).
+    keys = np.array([[5, 1], [2, 5]], np.int32)
+    pool = make_pool(keys, np.ones((2, 2), bool))
+    eng = Engine(wd_cfg(batch_size=2, query_pool_size=2), pool=pool)
+    st = eng.run(2)
+    assert int(st.txn.status[1]) == STATUS_BACKOFF
+    assert int(st.txn.restarts[1]) == 1
+    assert eng.summary(st)["total_txn_abort_cnt"] == 1
+
+
+def test_same_tick_ww_younger_dies():
+    # both request k5 first access in the same tick: older (slot 0) is
+    # processed first in ts order and wins; younger conflicts with a granted
+    # owner that is older -> die.
+    keys = np.array([[5, 1], [5, 2]], np.int32)
+    pool = make_pool(keys, np.ones((2, 2), bool))
+    eng = Engine(wd_cfg(batch_size=2, query_pool_size=2), pool=pool)
+    st = eng.run(1)
+    assert int(st.txn.cursor[0]) == 1
+    assert int(st.txn.status[1]) == STATUS_BACKOFF
+
+
+def test_ts_kept_across_restart():
+    # WAIT_DIE assigns its timestamp once at first start
+    # (worker_thread.cpp:478-480): after an abort+restart the ts must not change.
+    keys = np.array([[5, 1], [5, 2]], np.int32)
+    pool = make_pool(keys, np.ones((2, 2), bool))
+    eng = Engine(wd_cfg(batch_size=2, query_pool_size=2, abort_penalty_ticks=1),
+                 pool=pool)
+    st = eng.run(1)
+    ts_before = int(st.txn.ts[1])
+    st = eng.run(3, st)  # backoff expires, restarts
+    assert int(st.txn.ts[1]) == ts_before
+
+
+def test_no_deadlock_and_oracle_under_contention():
+    cfg = Config(batch_size=64, synth_table_size=256, req_per_query=4,
+                 query_pool_size=512, zipf_theta=0.9, tup_read_perc=0.5,
+                 cc_alg="WAIT_DIE", warmup_ticks=0)
+    eng = Engine(cfg)
+    st = eng.run(60)
+    s = eng.summary(st)
+    assert s["txn_cnt"] > 0
+    assert s["twopl_wait_cnt"] > 0      # waits must actually happen
+    assert np.asarray(st.data).sum() == s["write_cnt"]

@@ -1,0 +1,72 @@
+"""YCSB query-generator statistics vs the reference's formulas
+(benchmarks/ycsb_query.cpp:181-202,303-376)."""
+
+import numpy as np
+import pytest
+
+from deneva_tpu.config import Config
+from deneva_tpu.workloads import ycsb
+
+
+def test_zeta_matches_direct_sum():
+    n, theta = 1000, 0.6
+    direct = sum((1.0 / i) ** theta for i in range(1, n + 1))
+    assert abs(ycsb.zeta(n, theta) - direct) < 1e-9
+
+
+def test_zipf_range_and_skew():
+    n, theta = 4095, 0.9
+    s = ycsb.ZipfSampler(n, theta)
+    rng = np.random.default_rng(0)
+    x = s.sample(rng, 200_000)
+    assert x.min() >= 1 and x.max() <= n
+    # zipf pmf: p(k) = (1/k^theta)/zetan — check the head frequencies
+    zetan = s.zetan
+    for k in (1, 2, 3):
+        expect = (1.0 / k**theta) / zetan
+        got = float(np.mean(x == k))
+        assert abs(got - expect) < 0.01, (k, got, expect)
+
+
+def test_theta_zero_is_uniform():
+    n = 1023
+    s = ycsb.ZipfSampler(n, 0.0)
+    rng = np.random.default_rng(1)
+    x = s.sample(rng, 100_000)
+    # all keys roughly equally likely
+    counts = np.bincount(x, minlength=n + 1)[1:]
+    assert counts.min() > 0
+    assert counts.max() / counts.mean() < 1.6
+
+
+def test_pool_shape_and_distinct_keys():
+    cfg = Config(query_pool_size=2048, req_per_query=10,
+                 synth_table_size=1 << 12, zipf_theta=0.9)
+    pool = ycsb.gen_query_pool(cfg)
+    assert pool.keys.shape == (2048, 10)
+    # distinct keys within each txn (ycsb_query.cpp:346-353)
+    srt = np.sort(pool.keys, axis=1)
+    assert not (srt[:, 1:] == srt[:, :-1]).any()
+    assert pool.keys.min() >= 0
+    assert pool.keys.max() < cfg.synth_table_size
+
+
+def test_partition_striping():
+    cfg = Config(query_pool_size=1024, part_cnt=4, node_cnt=4,
+                 synth_table_size=1 << 12, first_part_local=True)
+    pool = ycsb.gen_query_pool(cfg)
+    # key % part_cnt == partition (ycsb_wl.cpp:70-74); first req on home part
+    assert (pool.keys[:, 0] % 4 == pool.home_part).all()
+    parts = np.unique(pool.keys % 4)
+    assert len(parts) == 4
+
+
+def test_write_fraction():
+    cfg = Config(query_pool_size=4096, tup_read_perc=0.5, txn_read_perc=0.0,
+                 synth_table_size=1 << 14)
+    pool = ycsb.gen_query_pool(cfg)
+    frac = pool.is_write.mean()
+    assert 0.45 < frac < 0.55
+    cfg2 = cfg.replace(txn_read_perc=1.0)
+    pool2 = ycsb.gen_query_pool(cfg2)
+    assert not pool2.is_write.any()
