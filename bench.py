@@ -31,6 +31,7 @@ def main():
         query_pool_size=1 << 16,
         warmup_ticks=0,
         backoff=True,
+        acquire_window=10,  # greedy batch acquisition (see Config docstring)
     )
     eng = Engine(cfg)
     state = eng.init_state()

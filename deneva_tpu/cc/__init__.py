@@ -2,6 +2,9 @@
 
 from deneva_tpu.cc.base import AccessDecision, CCPlugin
 from deneva_tpu.cc.no_wait import NoWait, WaitDie
+from deneva_tpu.cc.timestamp import Timestamp
+from deneva_tpu.cc.mvcc import Mvcc
+from deneva_tpu.cc.occ import Occ
 
 REGISTRY: dict[str, CCPlugin] = {}
 
@@ -13,6 +16,9 @@ def register(plugin: CCPlugin) -> CCPlugin:
 
 register(NoWait())
 register(WaitDie())
+register(Timestamp())
+register(Mvcc())
+register(Occ())
 
 
 def get(name: str) -> CCPlugin:

@@ -34,9 +34,11 @@ from deneva_tpu.engine.state import TxnState
 
 
 class AccessDecision(NamedTuple):
-    """Per-txn outcome for this tick's current access; masks are (B,) and
-    mutually exclusive, valid only where the engine marked the txn active
-    with an outstanding request."""
+    """Per-access outcome for this tick's requests; masks are (B, R) and
+    mutually exclusive, true only at requested access positions (the window
+    [cursor, cursor+acquire_window)).  The engine advances each txn's cursor
+    over its granted prefix and applies the wait/abort decision found at the
+    first non-granted requested access."""
 
     grant: jnp.ndarray
     wait: jnp.ndarray
@@ -62,13 +64,20 @@ class CCPlugin:
         raise NotImplementedError
 
     def validate(self, cfg: Config, db: dict, txn: TxnState,
-                 finishing: jnp.ndarray) -> tuple[jnp.ndarray, dict]:
+                 finishing: jnp.ndarray, tick: jnp.ndarray
+                 ) -> tuple[jnp.ndarray, dict]:
         return finishing, db
 
     def on_commit(self, cfg: Config, db: dict, txn: TxnState,
-                  committed: jnp.ndarray, commit_ts: jnp.ndarray) -> dict:
+                  committed: jnp.ndarray, commit_ts: jnp.ndarray,
+                  tick: jnp.ndarray) -> dict:
         return db
 
     def on_abort(self, cfg: Config, db: dict, txn: TxnState,
                  aborted: jnp.ndarray) -> dict:
+        return db
+
+    def on_ts_rebase(self, cfg: Config, db: dict, shift: jnp.ndarray) -> dict:
+        """Shift any timestamp-valued db arrays down by `shift` (the engine
+        periodically rebases int32 timestamps to dodge wraparound)."""
         return db

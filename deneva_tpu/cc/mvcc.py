@@ -1,0 +1,206 @@
+"""Multi-version timestamp ordering (CC_ALG=MVCC) — rebuild of Row_mvcc
+(concurrency_control/row_mvcc.cpp:198-364).
+
+Per-row state is a bounded version ring of HIS_RECYCLE_LEN slots
+(config.h:130), the tensorized write-history + read-history:
+
+  w_ring  (rows, H) — committed version timestamps (0 = empty slot)
+  r_ring  (rows, H) — max read-ts observed per version (per-version rts)
+  rts0    (rows,)   — read-ts on the implicit initial version (wts = 0)
+  w_floor (rows,)   — max version-ts ever evicted from the ring; any access
+                      whose target version falls at or below the floor
+                      cannot be resolved safely and aborts (the reference
+                      instead blocks recycling of in-use versions,
+                      row_mvcc.cpp:311-318)
+
+Eviction replaces the MINIMUM-ts slot (not insertion order): commits need
+not arrive in ts order (a long-running old txn can commit an old version
+late), and evicting by ts keeps the ring = "the H newest versions", which
+makes the floor rule sound: a read/prewrite at ts is safe iff no evicted
+version lies in (target_version_ts, ts].
+
+Decision rules (requests processed in ts order within a tick; a "pending
+prewrite" is a granted write access of a live txn):
+
+  READ at ts   : v = newest committed version with wts <= ts.
+                 w_floor in (v.wts, ts] -> Abort (target version evicted)
+                 pts = max pending-prewrite ts < ts on this row.
+                 pts > v.wts            -> WAIT  (conflict(): a prewrite-read
+                                          couple with no committed write in
+                                          between, row_mvcc.cpp:198-215)
+                 else grant; r_ring[v] = max(r_ring[v], ts)
+  WRITE at ts  : v = newest committed version with wts <= ts.
+                 w_floor in (v.wts, ts] -> Abort (cannot see evicted rts)
+                 r_ring[v] > ts         -> Abort (a read that observed v at a
+                                          later ts; row_mvcc.cpp:217-239)
+                 else grant (prewrite pending until commit)
+  commit       : insert one version per written row into the min-ts slot;
+                 when several txns commit the same row in one tick only the
+                 newest becomes a version, the others fold into w_floor
+                 (a reader between them would abort — safe, and such ties
+                 are rare)
+  abort        : pending prewrites vanish (XP_REQ debuffer); read history is
+                 retained, as in the reference (only P_REQ is debuffered)
+
+Within-tick one-directionality: sorted-by-ts processing means earlier
+entries (smaller ts) can affect later ones only via the pending-prewrite
+prefix; a same-tick granted read can never conflict a same-tick later
+prewrite (its ts is smaller), and later reads see earlier granted prewrites
+through the prefix — matching sequential arrival in ts order.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deneva_tpu.cc.base import AccessDecision, CCPlugin
+from deneva_tpu.config import Config
+from deneva_tpu.engine.state import TxnState, make_entries, NULL_KEY
+from deneva_tpu.ops import segment as seg
+
+
+class Mvcc(CCPlugin):
+    name = "MVCC"
+    new_ts_on_restart = True
+
+    def init_db(self, cfg: Config, n_rows: int, B: int, R: int) -> dict:
+        H = cfg.his_recycle_len
+        return {
+            "w_ring": jnp.zeros((n_rows, H), jnp.int32),
+            "r_ring": jnp.zeros((n_rows, H), jnp.int32),
+            "rts0": jnp.zeros(n_rows, jnp.int32),
+            "w_floor": jnp.zeros(n_rows, jnp.int32),
+        }
+
+    def on_ts_rebase(self, cfg: Config, db: dict, shift) -> dict:
+        shift_keep = lambda a: jnp.where(a > 0, jnp.maximum(a - shift, 1), 0)
+        return {**db,
+                "w_ring": shift_keep(db["w_ring"]),
+                "r_ring": shift_keep(db["r_ring"]),
+                "rts0": jnp.maximum(db["rts0"] - shift, 0),
+                "w_floor": jnp.maximum(db["w_floor"] - shift, 0)}
+
+    def _version_lookup(self, db, key, ts):
+        """Newest committed version with wts <= ts for each entry.
+
+        Returns (v_ts, v_slot, evicted): v_ts == 0 means the initial version;
+        evicted flags entries whose true target version may have left the
+        ring (an evicted version-ts lies in (v_ts, ts]).
+        """
+        n_rows, H = db["w_ring"].shape
+        k = jnp.clip(key, 0, n_rows - 1)
+        ring = db["w_ring"][k]                     # (n, H)
+        eligible = (ring > 0) & (ring <= ts[:, None])
+        v_ts = jnp.max(jnp.where(eligible, ring, 0), axis=1)
+        v_slot = jnp.argmax(jnp.where(eligible, ring, -1), axis=1)
+        floor = db["w_floor"][k]
+        evicted = (floor > v_ts) & (floor <= ts)
+        return v_ts, v_slot.astype(jnp.int32), evicted
+
+    def access(self, cfg: Config, db: dict, txn: TxnState, active):
+        ent = make_entries(txn, active, window=cfg.acquire_window)
+        n = ent.key.shape[0]
+        n_rows, H = db["w_ring"].shape
+        k = jnp.clip(ent.key, 0, n_rows - 1)
+
+        v_ts, v_slot, evicted = self._version_lookup(db, ent.key, ent.ts)
+        rts_v = jnp.where(v_ts > 0,
+                          db["r_ring"][k, v_slot], db["rts0"][k])
+
+        # prewrite rule: a later read already observed my target version
+        w_abort = (rts_v > ent.ts) | evicted
+
+        # pending-prewrite prefix per row segment (ts order)
+        (skey, sts), (s_iw, s_held, s_req, s_wab, s_orig) = seg.sort_by(
+            (ent.key, ent.ts),
+            (ent.is_write, ent.held, ent.req, w_abort,
+             jnp.arange(n, dtype=jnp.int32)),
+        )
+        starts = seg.segment_starts(skey)
+        live = skey != NULL_KEY
+        pending_w = live & s_iw & (s_held | (s_req & ~s_wab))
+        # max pending-prewrite ts strictly before me in ts order
+        pref = _prefix_max_seg(jnp.where(pending_w, sts, 0), starts)
+        pts = jnp.zeros_like(pref).at[s_orig].set(pref)
+
+        r_wait = (pts > v_ts) & (pts > 0)
+        r_abort = evicted
+
+        grant_e = ent.req & jnp.where(ent.is_write, ~w_abort,
+                                      ~r_abort & ~r_wait)
+        wait_e = ent.req & ~ent.is_write & ~r_abort & r_wait
+        abort_e = ent.req & ~grant_e & ~wait_e
+
+        # granted reads record their rts on the version they read
+        gr = grant_e & ~ent.is_write
+        r_ring = db["r_ring"].at[k, v_slot].max(
+            jnp.where(gr & (v_ts > 0), ent.ts, 0))
+        rts0 = db["rts0"].at[ent.key].max(
+            jnp.where(gr & (v_ts == 0), ent.ts, 0), mode="drop")
+
+        B, R = txn.keys.shape
+        return (AccessDecision(grant=grant_e.reshape(B, R),
+                               wait=wait_e.reshape(B, R),
+                               abort=abort_e.reshape(B, R)),
+                {**db, "r_ring": r_ring, "rts0": rts0})
+
+    def on_commit(self, cfg: Config, db: dict, txn: TxnState, committed,
+                  commit_ts, tick):
+        # insert the newest committed write per row into the min-ts slot;
+        # evicted and same-tick-shadowed version ts fold into w_floor
+        B, R = txn.keys.shape
+        n_rows, H = db["w_ring"].shape
+        n = B * R
+        ridx = jnp.arange(R, dtype=jnp.int32)[None, :]
+        wmask = (committed[:, None] & txn.is_write
+                 & (ridx < txn.n_req[:, None])).reshape(-1)
+        key = jnp.where(wmask, txn.keys.reshape(-1), NULL_KEY)
+        ts = jnp.broadcast_to(txn.ts[:, None], (B, R)).reshape(-1)
+
+        (skey, sts), _ = seg.sort_by((key, ts), ())
+        live = skey != NULL_KEY
+        idx = jnp.arange(n)
+        is_end = jnp.where(idx == n - 1, True, skey != jnp.roll(skey, -1))
+        winner = live & is_end
+        shadowed = live & ~winner   # older same-tick writes to the same row
+
+        kk = jnp.clip(skey, 0, n_rows - 1)
+        ring = db["w_ring"][kk]                     # (n, H)
+        slot = jnp.argmin(ring, axis=1).astype(jnp.int32)
+        evicted_ts = jnp.take_along_axis(ring, slot[:, None], axis=1)[:, 0]
+
+        # a version older than everything retained goes straight to the
+        # floor (inserting it would evict a NEWER version); otherwise it
+        # replaces the ring minimum, which moves to the floor
+        insert_ok = winner & (sts > evicted_ts)
+        ik = jnp.where(insert_ok, kk, n_rows)
+        w_ring = db["w_ring"].at[ik, slot].set(sts, mode="drop")
+        r_ring = db["r_ring"].at[ik, slot].set(0, mode="drop")
+        w_floor = db["w_floor"].at[jnp.where(winner, kk, n_rows)].max(
+            jnp.where(insert_ok, evicted_ts, sts), mode="drop")
+        w_floor = w_floor.at[jnp.where(shadowed, kk, n_rows)].max(
+            sts, mode="drop")
+        return {**db, "w_ring": w_ring, "r_ring": r_ring, "w_floor": w_floor}
+
+
+def _prefix_max_seg(vals: jnp.ndarray, starts: jnp.ndarray) -> jnp.ndarray:
+    """Exclusive per-segment running max of vals (0 where nothing before).
+
+    Segment-reset scan via an associative combine over (value, segment id).
+    """
+    n = vals.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    sid = seg.seg_ids(starts)
+
+    def combine(a, b):
+        av, aid = a
+        bv, bid = b
+        v = jnp.where(aid == bid, jnp.maximum(av, bv), bv)
+        return v, bid
+
+    incl, _ = jax.lax.associative_scan(combine, (vals, sid), axis=0)
+    # exclusive: value strictly before me within my segment
+    prev = jnp.where(idx == 0, 0, jnp.roll(incl, 1))
+    same_seg = jnp.where(idx == 0, False, jnp.roll(sid, 1) == sid)
+    return jnp.where(same_seg, prev, 0)

@@ -24,37 +24,34 @@ from deneva_tpu.config import Config, READ_UNCOMMITTED, READ_COMMITTED, NOLOCK
 from deneva_tpu.engine.state import TxnState, make_entries, NULL_KEY
 
 
-def _current_is_read(txn: TxnState) -> jnp.ndarray:
-    cur = jnp.minimum(txn.cursor, txn.R - 1)[:, None]
-    return ~jnp.take_along_axis(txn.is_write, cur, axis=1)[:, 0]
-
-
 class TwoPLPlugin(CCPlugin):
     policy = "NO_WAIT"
 
     def access(self, cfg: Config, db: dict, txn: TxnState, active):
-        has_req = active & (txn.cursor < txn.n_req)
-        z = jnp.zeros_like(has_req)
-
-        if cfg.isolation_level == NOLOCK:
-            return AccessDecision(grant=has_req, wait=z, abort=z), db
-
+        B, R = txn.keys.shape
         ent = make_entries(
             txn, active,
             read_locks_held=(cfg.isolation_level not in (READ_COMMITTED,
-                                                         READ_UNCOMMITTED)))
+                                                         READ_UNCOMMITTED)),
+            window=cfg.acquire_window)
+        z = jnp.zeros((B, R), dtype=bool)
+
+        if cfg.isolation_level == NOLOCK:
+            return AccessDecision(grant=ent.req.reshape(B, R), wait=z,
+                                  abort=z), db
+
         bypass = z
         if cfg.isolation_level == READ_UNCOMMITTED:
             # reads lock nothing: drop read requests from arbitration
             drop = ent.req & ~ent.is_write
+            bypass = drop.reshape(B, R)
             ent = ent._replace(key=jnp.where(drop, NULL_KEY, ent.key),
                                req=ent.req & ~drop)
-            bypass = has_req & _current_is_read(txn)
 
         g, w, a = twopl.arbitrate(ent, self.policy)
-        gt, wt, at_ = twopl.decisions_per_txn(ent, g, w, a, txn.B)
-        return AccessDecision(grant=gt | bypass, wait=wt & ~bypass,
-                              abort=at_ & ~bypass), db
+        return AccessDecision(grant=g.reshape(B, R) | bypass,
+                              wait=w.reshape(B, R),
+                              abort=a.reshape(B, R)), db
 
 
 class NoWait(TwoPLPlugin):

@@ -1,0 +1,93 @@
+"""Optimistic concurrency control (CC_ALG=OCC) — rebuild of OptCC
+(concurrency_control/occ.cpp:116-294, Kung-Robinson backward validation).
+
+The reference serializes every validation through a global semaphore and
+walks an unbounded history list of committed write sets
+(occ.cpp:136-141,277-286).  Here validation is a per-tick batch job with no
+critical section:
+
+- the history list becomes one dense array ``wcommit`` (rows,) holding the
+  scheduler tick of the last committed write per row; "some txn with commit
+  tn in (my start, my finish] wrote row k" is then the O(1) test
+  ``wcommit[k] > my start_tick`` (reads-only, occ.cpp:167-180);
+- the active-writer check (occ.cpp:185-199) becomes a same-tick sorted join:
+  txns finishing in the same tick are serialized by ts, and a txn conflicts
+  if an earlier-in-order finisher that itself passed the history check
+  writes a key in my read or write set (test_valid vs rset AND wset);
+- reads never block and never update shared state at access time (the work
+  phase is entirely optimistic), so ``access`` grants everything.
+
+start_ts is re-drawn per attempt (worker_thread.cpp:500-502); the engine's
+per-restart ``start_tick`` provides exactly that.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from deneva_tpu.cc.base import AccessDecision, CCPlugin
+from deneva_tpu.config import Config
+from deneva_tpu.engine.state import TxnState, NULL_KEY, make_entries
+from deneva_tpu.ops import segment as seg
+
+
+class Occ(CCPlugin):
+    name = "OCC"
+    new_ts_on_restart = True
+
+    def init_db(self, cfg: Config, n_rows: int, B: int, R: int) -> dict:
+        return {"occ_wcommit": jnp.full(n_rows, -1, jnp.int32)}
+
+    def access(self, cfg: Config, db: dict, txn: TxnState, active):
+        # optimistic work phase: every access proceeds immediately
+        B, R = txn.keys.shape
+        req = make_entries(txn, active,
+                           window=cfg.acquire_window).req.reshape(B, R)
+        z = jnp.zeros((B, R), dtype=bool)
+        return AccessDecision(grant=req, wait=z, abort=z), db
+
+    def validate(self, cfg: Config, db: dict, txn: TxnState, finishing, tick):
+        B, R = txn.keys.shape
+        n_rows = db["occ_wcommit"].shape[0]
+        ridx = jnp.arange(R, dtype=jnp.int32)[None, :]
+        valid_acc = finishing[:, None] & (ridx < txn.n_req[:, None])
+        rmask = valid_acc & ~txn.is_write
+        wmask = valid_acc & txn.is_write
+
+        # --- history check: a committed write landed on my read set after
+        # my (re)start (occ.cpp:167-180) ---
+        k = jnp.clip(txn.keys, 0, n_rows - 1)
+        hist_conflict = rmask & (db["occ_wcommit"][k] > txn.start_tick[:, None])
+        pass1 = finishing & ~hist_conflict.any(axis=1)
+
+        # --- same-tick active-writer check (occ.cpp:185-199): serialize
+        # this tick's finishers by ts; I conflict if an earlier finisher
+        # that passed the history check writes a key I read or write ---
+        ent_live = (valid_acc & pass1[:, None]).reshape(-1)
+        key = jnp.where(ent_live, txn.keys.reshape(-1), NULL_KEY)
+        ts = jnp.broadcast_to(txn.ts[:, None], (B, R)).reshape(-1)
+        iw = txn.is_write.reshape(-1)
+        tx = jnp.broadcast_to(
+            jnp.arange(B, dtype=jnp.int32)[:, None], (B, R)).reshape(-1)
+        n = B * R
+        (skey, sts), (s_iw, s_tx, s_orig) = seg.sort_by(
+            (key, ts), (iw, tx, jnp.arange(n, dtype=jnp.int32)))
+        starts = seg.segment_starts(skey)
+        live = skey != NULL_KEY
+        w_before = seg.seg_any_before(s_iw & live, starts)
+        conflict_sorted = live & w_before
+        conflict = jnp.zeros(n, dtype=bool).at[s_orig].set(conflict_sorted)
+        pass2_fail = conflict.reshape(B, R).any(axis=1)
+
+        return pass1 & ~pass2_fail, db
+
+    def on_commit(self, cfg: Config, db: dict, txn: TxnState, committed,
+                  commit_ts, tick):
+        # append my write set to "history": bump each written row's last
+        # committed-write tick (occ.cpp:277-286, tn = tnc++)
+        B, R = txn.keys.shape
+        ridx = jnp.arange(R, dtype=jnp.int32)[None, :]
+        wmask = committed[:, None] & txn.is_write & (ridx < txn.n_req[:, None])
+        wcommit = db["occ_wcommit"].at[txn.keys.reshape(-1)].max(
+            jnp.where(wmask, tick, -1).reshape(-1), mode="drop")
+        return {**db, "occ_wcommit": wcommit}

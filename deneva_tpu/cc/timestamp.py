@@ -1,0 +1,112 @@
+"""Basic timestamp ordering (CC_ALG=TIMESTAMP) — rebuild of Row_ts
+(concurrency_control/row_ts.cpp:167-323).
+
+Per-row state is two dense int32 arrays (wts, rts) updated with scatter-max
+(monotone, so incremental updates never need undo).  The reference's three
+request buffers collapse into the engine's entry tensors:
+
+- "pending prewrite" == a granted write access of a live txn (the P_REQ
+  buffer);
+- a WAITING read == the R_REQ buffer: it re-checks every tick, and when the
+  blocking prewriter commits or aborts its entries vanish, which is exactly
+  Row_ts::update_buffer's debuffering cascade one tick later;
+- the reference buffers committed writes (W_REQ) until older pending reads
+  drain so those reads see the old value; here reads take effect logically
+  at grant time and writes at commit time, so an older granted read already
+  read "before" the write — the buffering is unnecessary rather than
+  unfaithful.
+
+Decision rules (per request, processed in ts order within the tick):
+
+  READ  at ts: ts < wts[k]                        -> Abort  (row_ts.cpp:176)
+               exists pending prewrite pts < ts   -> WAIT   (row_ts.cpp:181)
+               else grant, rts[k] = max(rts[k],ts)          (row_ts.cpp:187)
+  WRITE at ts: ts < rts[k] or ts < wts[k]         -> Abort  (row_ts.cpp:192-200)
+               else grant (prewrite buffered)
+  commit:      wts[k] = max(wts[k], ts) for writes; value applied
+  TS_TWR:      ts < wts[k] does not abort the prewrite; at commit a stale
+               write (ts < wts) is skipped (Thomas write rule, config.h:123)
+
+Within a tick, requests are arbitrated as if arriving in ts order, so only
+entries with smaller ts can affect a decision; a same-tick granted prewrite
+with smaller ts correctly blocks a later read (pending-prewrite rule).
+
+Same-txn re-accesses of one key are not modeled (YCSB keys are distinct per
+txn; TPC-C programs access each row once per step).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from deneva_tpu.cc.base import AccessDecision, CCPlugin
+from deneva_tpu.config import Config
+from deneva_tpu.engine.state import TxnState, make_entries, NULL_KEY
+from deneva_tpu.ops import segment as seg
+
+
+class Timestamp(CCPlugin):
+    name = "TIMESTAMP"
+    new_ts_on_restart = True  # is_cc_new_timestamp(), worker_thread.cpp:492
+
+    def init_db(self, cfg: Config, n_rows: int, B: int, R: int) -> dict:
+        return {
+            "wts": jnp.zeros(n_rows, jnp.int32),
+            "rts": jnp.zeros(n_rows, jnp.int32),
+        }
+
+    def on_ts_rebase(self, cfg: Config, db: dict, shift) -> dict:
+        return {**db,
+                "wts": jnp.maximum(db["wts"] - shift, 0),
+                "rts": jnp.maximum(db["rts"] - shift, 0)}
+
+    def access(self, cfg: Config, db: dict, txn: TxnState, active):
+        ent = make_entries(txn, active, window=cfg.acquire_window)
+        n = ent.key.shape[0]
+        wts_k = db["wts"][jnp.clip(ent.key, 0, db["wts"].shape[0] - 1)]
+        rts_k = db["rts"][jnp.clip(ent.key, 0, db["rts"].shape[0] - 1)]
+
+        # per-request dense-state rules (independent of other entries)
+        if cfg.ts_twr:
+            w_abort = ent.ts < rts_k
+        else:
+            w_abort = (ent.ts < rts_k) | (ent.ts < wts_k)
+        r_abort = ent.ts < wts_k
+
+        # pending-prewrite rule needs ts-ordered prefix info per row segment:
+        # "a write entry (held prewrite, or prewrite granted earlier this
+        # tick) with smaller ts exists on my key"
+        (skey, sts), (s_iw, s_held, s_req, s_wab, s_orig) = seg.sort_by(
+            (ent.key, ent.ts),
+            (ent.is_write, ent.held, ent.req, w_abort,
+             jnp.arange(n, dtype=jnp.int32)),
+        )
+        starts = seg.segment_starts(skey)
+        live = skey != NULL_KEY
+        pending_w = live & s_iw & (s_held | (s_req & ~s_wab))
+        pw_before = seg.seg_any_before(pending_w, starts)
+        unsort = lambda x: jnp.zeros_like(x).at[s_orig].set(x)
+        pw_before = unsort(pw_before)
+
+        grant_e = ent.req & jnp.where(ent.is_write, ~w_abort,
+                                      ~r_abort & ~pw_before)
+        wait_e = ent.req & ~ent.is_write & ~r_abort & pw_before
+        abort_e = ent.req & ~grant_e & ~wait_e
+
+        # granted reads advance rts immediately (row_ts.cpp:187-189)
+        rts = db["rts"].at[ent.key].max(
+            jnp.where(grant_e & ~ent.is_write, ent.ts, 0), mode="drop")
+
+        B, R = txn.keys.shape
+        return (AccessDecision(grant=grant_e.reshape(B, R),
+                               wait=wait_e.reshape(B, R),
+                               abort=abort_e.reshape(B, R)),
+                {**db, "rts": rts})
+
+    def on_commit(self, cfg: Config, db: dict, txn: TxnState, committed,
+                  commit_ts, tick):
+        ridx = jnp.arange(txn.R, dtype=jnp.int32)[None, :]
+        wmask = committed[:, None] & txn.is_write & (ridx < txn.n_req[:, None])
+        wts = db["wts"].at[txn.keys.reshape(-1)].max(
+            jnp.where(wmask, txn.ts[:, None], 0).reshape(-1), mode="drop")
+        return {**db, "wts": wts}

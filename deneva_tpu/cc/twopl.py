@@ -29,35 +29,57 @@ On failure the per-algorithm rules of row_lock.cpp apply:
 Waiters hold no explicit queue: a WAITING txn re-submits the same request
 with the same priority next tick, which reproduces the priority-ordered
 waiter list of the reference (waiters kept in ts order, row_lock.cpp:134-141).
+
+The sort is packed to three int32 operands (two keys + one payload) to keep
+the TPU bitonic sort cheap: key/kind share one word (config asserts row ids
+fit 30 bits) and flags/index share another (entry index fits 23 bits).
 """
 
 from __future__ import annotations
 
 import jax.numpy as jnp
+from jax import lax
 
-from deneva_tpu.engine.state import Entries, BIG_TS, NULL_KEY
+from deneva_tpu.engine.state import Entries, BIG_TS
 from deneva_tpu.ops import segment as seg
+
+_IDX_BITS = 23
+_IDX_MASK = (1 << _IDX_BITS) - 1
+_DEAD_ROW = (1 << 30) - 1
 
 
 def arbitrate(ent: Entries, policy: str):
     """Resolve this tick's lock requests.
 
-    Returns (grant, wait, abort): (B*R,)-shaped masks in *original entry
-    order*, true only at request positions.
+    Returns (grant, wait, abort): (B*R,) masks in original entry order,
+    true only at request positions.
     """
     n = ent.key.shape[0]
-    kind = jnp.where(ent.held, 0, 1).astype(jnp.int32)  # held sorts first
-    (skey, _, sts), (s_iw, s_held, s_req, s_orig) = seg.sort_by(
-        (ent.key, kind, ent.ts),
-        (ent.is_write, ent.held, ent.req, jnp.arange(n, dtype=jnp.int32)),
-    )
-    starts = seg.segment_starts(skey)
+    assert n <= 1 << _IDX_BITS, n
+    live = ent.held | ent.req
+    row = jnp.where(live, ent.key, _DEAD_ROW)
+    kind = jnp.where(ent.held, 0, 1)
+    keykind = row * 2 + kind
+    payload = (jnp.arange(n, dtype=jnp.int32)
+               | (ent.is_write.astype(jnp.int32) << _IDX_BITS)
+               | (ent.held.astype(jnp.int32) << (_IDX_BITS + 1))
+               | (ent.req.astype(jnp.int32) << (_IDX_BITS + 2)))
+
+    skk, sts, spay = lax.sort((keykind, ent.ts, payload), num_keys=2,
+                              is_stable=False)
+    s_iw = (spay >> _IDX_BITS) & 1 == 1
+    s_held = (spay >> (_IDX_BITS + 1)) & 1 == 1
+    s_req = (spay >> (_IDX_BITS + 2)) & 1 == 1
+    s_idx = spay & _IDX_MASK
+    srow = skk >> 1
+    s_live = srow != _DEAD_ROW
+
+    starts = seg.segment_starts(srow)
     pos = seg.pos_in_segment(starts)
-    live = skey != NULL_KEY
 
     if policy == "CALVIN":
         # FIFO: any write earlier in the segment (granted or not) blocks.
-        any_w_before = seg.seg_any_before(s_iw & live, starts)
+        any_w_before = seg.seg_any_before(s_iw & s_live, starts)
         s_grant = s_req & jnp.where(s_iw, pos == 0, ~any_w_before)
         s_wait = s_req & ~s_grant
         s_abort = jnp.zeros_like(s_grant)
@@ -66,7 +88,8 @@ def arbitrate(ent: Entries, policy: str):
         # is also necessarily at position 0 (exclusive => sole live entry
         # apart from this tick's requests).  So "conflicting lock earlier in
         # order" == "a write at pos 0 or a held write before me".
-        eff_w_before = seg.seg_any_before(s_iw & live & (s_held | (pos == 0)), starts)
+        eff_w_before = seg.seg_any_before(
+            s_iw & s_live & (s_held | (pos == 0)), starts)
         s_grant = s_req & jnp.where(s_iw, pos == 0, ~eff_w_before)
         s_fail = s_req & ~s_grant
         if policy == "NO_WAIT":
@@ -81,12 +104,7 @@ def arbitrate(ent: Entries, policy: str):
         else:  # pragma: no cover
             raise ValueError(policy)
 
-    # scatter back to original entry order
-    unsort = lambda x: jnp.zeros_like(x).at[s_orig].set(x)
-    return unsort(s_grant), unsort(s_wait), unsort(s_abort)
-
-
-def decisions_per_txn(ent: Entries, grant, wait, abort, B: int):
-    """Reduce per-entry request decisions to per-txn masks (one request/txn)."""
-    to_txn = lambda m: jnp.zeros(B, dtype=bool).at[ent.txn].max(m & ent.req)
-    return to_txn(grant), to_txn(wait), to_txn(abort)
+    packed = (s_grant.astype(jnp.int32) | (s_wait.astype(jnp.int32) << 1)
+              | (s_abort.astype(jnp.int32) << 2))
+    out = jnp.zeros(n, jnp.int32).at[s_idx].set(packed)
+    return out & 1 == 1, (out >> 1) & 1 == 1, (out >> 2) & 1 == 1

@@ -61,6 +61,14 @@ class Config:
     batch_size: int = 4096       # concurrent in-flight txns per node (B)
     max_ticks: int = 1_000_000   # safety bound on scheduler ticks per run
     warmup_ticks: int = 0        # stats gated like is_warmup_done() (config.h:349)
+    #: how many of a txn's not-yet-granted accesses are attempted per tick.
+    #: 1 = reference-faithful sequential state machine (one row per
+    #: YCSB_0/YCSB_1 step); req_per_query = greedy batch acquisition (a txn
+    #: can finish in ~2 ticks).  Greedy mode arbitrates accesses the
+    #: sequential reference would not have requested yet, which can shift
+    #: abort rates under contention (grants past a txn's first failed access
+    #: are dropped, and T/O read-timestamp bumps from dropped reads persist).
+    acquire_window: int = 1
 
     # --- abort/backoff (reference config.h:112-114 ABORT_PENALTY/BACKOFF) ---
     abort_penalty_ticks: int = 1
@@ -115,6 +123,9 @@ class Config:
         assert self.isolation_level in ISOLATION_LEVELS
         assert self.part_cnt >= self.node_cnt and self.part_cnt % self.node_cnt == 0
         assert self.synth_table_size % self.part_cnt == 0
+        # row ids must fit 30 bits: lock arbitration packs (row_id, kind)
+        # into one int32 sort key (deneva_tpu/cc/twopl.py)
+        assert self.synth_table_size < 1 << 30, "table too large for packed sort keys"
 
     @property
     def rows_per_part(self) -> int:

@@ -129,10 +129,10 @@ def make_tick(cfg: Config, plugin, pool_dev: dict):
 
         # ---- 3. commit phase ----
         finishing = (txn.status == STATUS_RUNNING) & (txn.cursor >= txn.n_req)
-        ok, db = plugin.validate(cfg, db, txn, finishing)
+        ok, db = plugin.validate(cfg, db, txn, finishing, t)
         commit = finishing & ok
         vabort = finishing & ~ok
-        db = plugin.on_commit(cfg, db, txn, commit, commit_ts=txn.ts)
+        db = plugin.on_commit(cfg, db, txn, commit, commit_ts=txn.ts, tick=t)
 
         ridx = jnp.arange(txn.R, dtype=jnp.int32)[None, :]
         wmask = commit[:, None] & txn.is_write & (ridx < txn.n_req[:, None])
@@ -160,13 +160,26 @@ def make_tick(cfg: Config, plugin, pool_dev: dict):
             & ~vabort
         has_req = active & (txn.cursor < txn.n_req)
         dec, db = plugin.access(cfg, db, txn, active)
-        grant = dec.grant & has_req
-        wait = dec.wait & has_req
-        abort_now = (dec.abort & has_req) | vabort
 
-        cursor = jnp.where(grant, txn.cursor + 1, txn.cursor)
-        status = jnp.where(grant, STATUS_RUNNING,
-                  jnp.where(wait, STATUS_WAITING, txn.status))
+        # advance each txn over the granted prefix of its access program;
+        # the wait/abort outcome is whatever the first non-granted requested
+        # access decided (grants past it are dropped — next tick re-requests)
+        R = txn.R
+        ridx2 = jnp.arange(R, dtype=jnp.int32)[None, :]
+        ok = dec.grant | (ridx2 < txn.cursor[:, None]) \
+            | (ridx2 >= txn.n_req[:, None])
+        prefix = jnp.cumprod(ok.astype(jnp.int32), axis=1)
+        new_cursor = jnp.minimum(jnp.sum(prefix, axis=1), txn.n_req)
+        fail_pos = jnp.minimum(new_cursor, R - 1)[:, None]
+        at_fail = lambda m: jnp.take_along_axis(m, fail_pos, axis=1)[:, 0]
+        blocked = has_req & (new_cursor < txn.n_req)
+        wait = blocked & at_fail(dec.wait)
+        abort_now = (blocked & at_fail(dec.abort)) | vabort
+
+        cursor = jnp.where(has_req & ~abort_now, new_cursor, txn.cursor)
+        status = jnp.where(has_req & (new_cursor > txn.cursor), STATUS_RUNNING,
+                           txn.status)
+        status = jnp.where(wait, STATUS_WAITING, status)
         stats = bump(stats, "twopl_wait_cnt",
                      jnp.sum(wait.astype(jnp.int32)), measuring)
 
@@ -190,12 +203,19 @@ def make_tick(cfg: Config, plugin, pool_dev: dict):
         # ts wraparound guard: only relative order matters, and every live
         # txn's ts lies within [ts_counter - horizon, ts_counter], so rebase
         # all timestamps periodically instead of letting int32 overflow
-        # (at ~1M admissions/s int32 would wrap in ~35 min of simulation)
+        # (at ~1M admissions/s int32 would wrap in ~35 min of simulation).
+        # Fires once per ~1.6B draws: guard the O(rows) work with lax.cond.
         REBASE_AT, REBASE_BY = jnp.int32(3 << 29), jnp.int32(1 << 30)
-        do_rebase = ts_counter > REBASE_AT
-        shift_ts = jnp.where(do_rebase, REBASE_BY, 0)
-        txn = txn._replace(ts=jnp.maximum(txn.ts - shift_ts, 1))
-        ts_counter = ts_counter - shift_ts
+
+        def _rebase(op):
+            txn_, db_, tsc = op
+            txn_ = txn_._replace(ts=jnp.maximum(txn_.ts - REBASE_BY, 1))
+            db_ = plugin.on_ts_rebase(cfg, db_, REBASE_BY)
+            return txn_, db_, tsc - REBASE_BY
+
+        txn, db, ts_counter = jax.lax.cond(
+            ts_counter > REBASE_AT, _rebase, lambda op: op,
+            (txn, db, ts_counter))
 
         stats = bump(stats, "measured_ticks", 1, measuring)
         return EngineState(txn=txn, db=db, data=data, stats=stats,

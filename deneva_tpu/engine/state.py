@@ -82,13 +82,16 @@ class Entries(NamedTuple):
 
 
 def make_entries(txn: TxnState, active: jnp.ndarray,
-                 read_locks_held: bool = True) -> Entries:
+                 read_locks_held: bool = True,
+                 window: int = 1) -> Entries:
     """Build the live entry view for lock-style arbitration.
 
     ``active``: (B,) mask of txns participating (RUNNING | WAITING).
     ``read_locks_held``: False under READ_COMMITTED — S-locks release
     immediately after the read (reference config.h:336-340, txn.cpp:707-728),
     so completed read accesses are not held entries.
+    ``window``: accesses [cursor, cursor+window) are requested this tick
+    (Config.acquire_window; 1 = the reference's sequential state machine).
     """
     B, R = txn.keys.shape
     ridx = jnp.broadcast_to(jnp.arange(R, dtype=jnp.int32), (B, R))
@@ -97,7 +100,7 @@ def make_entries(txn: TxnState, active: jnp.ndarray,
     held = act & (ridx < cur)
     if not read_locks_held:
         held = held & txn.is_write
-    req = act & (ridx == cur) & (cur < txn.n_req[:, None])
+    req = act & (ridx >= cur) & (ridx < cur + window) & (ridx < txn.n_req[:, None])
     live = held | req
     flat = lambda x: x.reshape(-1)
     return Entries(

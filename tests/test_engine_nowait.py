@@ -8,13 +8,15 @@ from deneva_tpu.engine.scheduler import Engine
 from deneva_tpu.workloads.base import QueryPool
 
 
-def make_pool(keys, is_write):
+def make_pool(keys, is_write, n_req=None):
     keys = np.asarray(keys, np.int32)
     is_write = np.asarray(is_write, bool)
     Q, R = keys.shape
+    if n_req is None:
+        n_req = np.full(Q, R, np.int32)
     return QueryPool(
         keys=keys, is_write=is_write,
-        n_req=np.full(Q, R, np.int32),
+        n_req=np.asarray(n_req, np.int32),
         home_part=np.zeros(Q, np.int32),
         txn_type=np.zeros(Q, np.int32),
         args=np.zeros((Q, 1), np.int32),

@@ -1,0 +1,78 @@
+"""OCC golden micro-schedules (OptCC::central_validate, occ.cpp:116-294)."""
+
+import numpy as np
+import pytest
+
+from deneva_tpu.config import Config
+from deneva_tpu.engine.scheduler import Engine
+from deneva_tpu.engine.state import STATUS_BACKOFF
+from tests.test_engine_nowait import make_pool, small_cfg
+
+
+def test_committed_write_in_window_aborts_reader():
+    # txn0 (start tick 0): [k5 R, k1 R, k2 R]; txn1: [k5 W, k8 W] n_req=2.
+    # txn1 commits at tick2 (wcommit[k5]=2 > txn0.start_tick=0)
+    # -> txn0's validation at tick3 fails (occ.cpp:167-180).
+    keys = np.array([[5, 1, 2], [5, 8, 8]], np.int32)
+    iw = np.array([[False, False, False], [True, True, True]])
+    pool = make_pool(keys, iw, n_req=[3, 2])
+    eng = Engine(small_cfg(cc_alg="OCC", batch_size=2, query_pool_size=2,
+                           req_per_query=3), pool=pool)
+    st = eng.run(4)
+    s = eng.summary(st)
+    assert s["txn_cnt"] == 1                  # txn1
+    assert int(st.txn.status[0]) == STATUS_BACKOFF
+    assert s["total_txn_abort_cnt"] == 1
+
+
+def test_same_tick_writer_kills_later_reader():
+    # both finish the same tick; serialized by ts: txn0 (writer, older)
+    # passes, txn1 (reader of the same key, younger) conflicts
+    # (active-writer check, occ.cpp:185-199).
+    keys = np.array([[5, 1], [5, 2]], np.int32)
+    iw = np.array([[True, True], [False, False]])
+    pool = make_pool(keys, iw)
+    eng = Engine(small_cfg(cc_alg="OCC", batch_size=2, query_pool_size=2),
+                 pool=pool)
+    st = eng.run(3)
+    s = eng.summary(st)
+    assert s["txn_cnt"] == 1
+    assert int(st.txn.status[1]) == STATUS_BACKOFF
+
+
+def test_same_tick_disjoint_writers_both_commit():
+    # earlier reader does not invalidate later writer (backward validation
+    # checks only earlier WRITE sets)
+    keys = np.array([[5, 1], [5, 2]], np.int32)
+    iw = np.array([[False, True], [True, True]])
+    pool = make_pool(keys, iw)
+    eng = Engine(small_cfg(cc_alg="OCC", batch_size=2, query_pool_size=2),
+                 pool=pool)
+    st = eng.run(3)
+    # txn0 reads k5, writes k1; txn1 (younger) writes k5,k2: txn1's write of
+    # k5 sits after txn0's READ only -> both commit
+    assert eng.summary(st)["txn_cnt"] == 2
+
+
+def test_read_only_never_aborts():
+    cfg = Config(batch_size=32, synth_table_size=256, req_per_query=4,
+                 query_pool_size=256, zipf_theta=0.9, txn_read_perc=1.0,
+                 cc_alg="OCC", warmup_ticks=0)
+    eng = Engine(cfg)
+    st = eng.run(30)
+    s = eng.summary(st)
+    assert s["total_txn_abort_cnt"] == 0
+    assert s["txn_cnt"] > 0
+
+
+@pytest.mark.parametrize("window", [1, 4])
+def test_oracle_under_contention(window):
+    cfg = Config(batch_size=64, synth_table_size=256, req_per_query=4,
+                 query_pool_size=512, zipf_theta=0.9, tup_read_perc=0.5,
+                 cc_alg="OCC", warmup_ticks=0, acquire_window=window)
+    eng = Engine(cfg)
+    st = eng.run(60)
+    s = eng.summary(st)
+    assert s["txn_cnt"] > 0
+    assert s["total_txn_abort_cnt"] > 0       # hot keys must conflict
+    assert np.asarray(st.data).sum() == s["write_cnt"]

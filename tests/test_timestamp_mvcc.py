@@ -1,0 +1,154 @@
+"""Golden micro-schedules for the T/O family (row_ts.cpp / row_mvcc.cpp)."""
+
+import numpy as np
+import pytest
+
+from deneva_tpu.config import Config
+from deneva_tpu.engine.scheduler import Engine
+from deneva_tpu.engine.state import STATUS_BACKOFF, STATUS_WAITING
+from tests.test_engine_nowait import make_pool, small_cfg
+
+
+def test_write_too_late_aborts():
+    # txn0 (ts=1): [k1 W, k5 W]; txn1 (ts=2): [k5 R, k2 R].
+    # tick0: txn0 prewrites k1; txn1 reads k5 -> rts[k5]=2.
+    # tick1: txn0 prewrites k5 at ts=1 < rts=2 -> Abort (row_ts.cpp:192-194).
+    keys = np.array([[1, 5], [5, 2]], np.int32)
+    iw = np.array([[True, True], [False, False]])
+    pool = make_pool(keys, iw)
+    eng = Engine(small_cfg(cc_alg="TIMESTAMP", batch_size=2, query_pool_size=2),
+                 pool=pool)
+    st = eng.run(2)
+    assert int(st.txn.status[0]) == STATUS_BACKOFF
+    assert eng.summary(st)["total_txn_abort_cnt"] == 1
+
+
+def test_read_waits_on_older_prewrite_then_proceeds():
+    # txn0 (ts=1): [k5 W, k1 W]; txn1 (ts=2): [k2 R, k5 R].
+    # tick1: txn1's read of k5 at ts=2 sees pending prewrite (pts=1 < 2)
+    #        -> WAIT (row_ts.cpp:181-186).
+    # tick2: txn0 commits (wts[k5]=1); txn1's read retries: 2 >= 1 -> grant.
+    keys = np.array([[5, 1], [2, 5]], np.int32)
+    iw = np.array([[True, True], [False, False]])
+    pool = make_pool(keys, iw)
+    eng = Engine(small_cfg(cc_alg="TIMESTAMP", batch_size=2, query_pool_size=2),
+                 pool=pool)
+    st = eng.run(2)
+    assert int(st.txn.status[1]) == STATUS_WAITING
+    st = eng.run(1, st)
+    assert int(st.txn.cursor[1]) == 2       # read granted after commit
+    s = eng.summary(st)
+    assert s["txn_cnt"] == 1 and s["total_txn_abort_cnt"] == 0
+
+
+def _old_read_pool():
+    # txn0 (ts=1): [k7 R, k6 R, k5 R] — reads k5 in tick2's access phase.
+    # txn1 (ts=2): [k5 W, k8 W], n_req=2 — finishes tick1, commits in
+    # tick2's commit phase (before txn0's read): wts[k5] = 2 > 1.
+    keys = np.array([[7, 6, 5], [5, 8, 8]], np.int32)
+    iw = np.array([[False, False, False], [True, True, True]])
+    return make_pool(keys, iw, n_req=[3, 2])
+
+
+def test_to_aborts_but_mvcc_reads_old_version():
+    # txn1 commits version wts=2 of k5 at tick3; txn0 reads k5 at ts=1 in
+    # tick3 (after commit phase).  TIMESTAMP: 1 < wts=2 -> Abort
+    # (row_ts.cpp:176).  MVCC: no version <= ts=1 exists but ring never
+    # wrapped -> initial version serves the read (row_mvcc.cpp:266-271).
+    pool = _old_read_pool()
+    cfg = dict(batch_size=2, query_pool_size=2, req_per_query=3)
+
+    eng_to = Engine(small_cfg(cc_alg="TIMESTAMP", **cfg), pool=pool)
+    st = eng_to.run(4)
+    assert eng_to.summary(st)["total_txn_abort_cnt"] >= 1
+
+    eng_mv = Engine(small_cfg(cc_alg="MVCC", **cfg), pool=pool)
+    st = eng_mv.run(5)
+    s = eng_mv.summary(st)
+    assert s["total_txn_abort_cnt"] == 0
+    assert s["txn_cnt"] >= 2
+
+
+def test_mvcc_write_too_late_aborts():
+    # txn2 (ts=3) reads k5 (version 0) at tick0 -> rts0[k5]=3.
+    # txn0 (ts=1) prewrites k5 at tick1: target version 0 has rts=3 > 1
+    # -> Abort (row_mvcc.cpp:217-239).
+    keys = np.array([[1, 5, 9], [11, 12, 13], [5, 8, 7]], np.int32)
+    iw = np.array([[True, True, True], [False, False, False],
+                   [False, False, False]])
+    pool = make_pool(keys, iw)
+    eng = Engine(small_cfg(cc_alg="MVCC", batch_size=3, query_pool_size=3,
+                           req_per_query=3), pool=pool)
+    st = eng.run(2)
+    assert int(st.txn.status[0]) == STATUS_BACKOFF
+
+
+@pytest.mark.parametrize("alg", ["TIMESTAMP", "MVCC"])
+@pytest.mark.parametrize("window", [1, 4])
+def test_oracle_under_contention(alg, window):
+    cfg = Config(batch_size=64, synth_table_size=256, req_per_query=4,
+                 query_pool_size=512, zipf_theta=0.9, tup_read_perc=0.5,
+                 cc_alg=alg, warmup_ticks=0, acquire_window=window,
+                 his_recycle_len=4)
+    eng = Engine(cfg)
+    st = eng.run(60)
+    s = eng.summary(st)
+    assert s["txn_cnt"] > 0
+    assert np.asarray(st.data).sum() == s["write_cnt"]
+
+
+@pytest.mark.parametrize("alg", ["NO_WAIT", "WAIT_DIE"])
+def test_greedy_window_oracle_and_progress(alg):
+    # low contention (128 concurrent requests on 16k rows): greedy mode
+    # completes txns in ~2-3 ticks instead of R+1
+    cfg = Config(batch_size=32, synth_table_size=1 << 14, req_per_query=4,
+                 query_pool_size=512, zipf_theta=0.0, tup_read_perc=0.5,
+                 cc_alg=alg, warmup_ticks=0, acquire_window=4)
+    eng = Engine(cfg)
+    st = eng.run(30)
+    s = eng.summary(st)
+    assert s["txn_cnt"] > 250          # vs ~180 max in strict mode (30/5*32)
+    assert np.asarray(st.data).sum() == s["write_cnt"]
+
+
+def test_mvcc_out_of_order_commit_does_not_serve_stale_version():
+    # Ring H=1.  txn1 (ts=2) commits k5 quickly (version 2).  txn0 (ts=1,
+    # long-running) commits its k5 write LATE: with eviction by insertion
+    # order the late old version would shadow version 2 and a read at ts=3
+    # would silently be served version 1; with min-ts replacement + floor,
+    # version 1 folds into w_floor, version 2 stays, and the ts=3 read
+    # correctly observes version 2.
+    keys = np.array([[5, 1, 2, 3], [5, 8, 8, 8], [7, 9, 10, 5]], np.int32)
+    iw = np.array([[True, True, True, True],
+                   [True, True, True, True],
+                   [False, False, False, False]])
+    pool = make_pool(keys, iw, n_req=[4, 2, 4])
+    eng = Engine(small_cfg(cc_alg="MVCC", batch_size=3, query_pool_size=3,
+                           req_per_query=4), pool=pool)
+    eng_cfg = eng.cfg.replace(his_recycle_len=1)
+    eng = Engine(eng_cfg, pool=pool)
+    st = eng.run(6)   # up to txn0's late commit, before pool wraparound
+    db = st.db
+    # version 2 must still be in the ring (not shadowed by the late ts=1)
+    assert int(np.asarray(db["w_ring"][5, 0])) == 2
+    assert int(np.asarray(db["w_floor"][5])) >= 1
+    s = eng.summary(st)
+    assert np.asarray(st.data).sum() == s["write_cnt"]
+    # same-tick same-row committers: winner must be chosen by ts, not slot
+    # order (two reincarnated writers of k5 with ts 4 and 5 commit together
+    # at tick 7 after the pool wraps)
+    st = eng.run(2, st)
+    assert int(np.asarray(st.db["w_ring"][5, 0])) == 5
+    assert int(np.asarray(st.db["w_floor"][5])) >= 4
+
+
+def test_mvcc_ring_eviction_is_safe():
+    # tiny ring + hot keys: evictions must abort readers, never corrupt
+    cfg = Config(batch_size=32, synth_table_size=64, req_per_query=2,
+                 query_pool_size=256, zipf_theta=0.9, tup_read_perc=0.3,
+                 cc_alg="MVCC", warmup_ticks=0, his_recycle_len=2)
+    eng = Engine(cfg)
+    st = eng.run(80)
+    s = eng.summary(st)
+    assert s["txn_cnt"] > 0
+    assert np.asarray(st.data).sum() == s["write_cnt"]
