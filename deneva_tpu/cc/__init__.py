@@ -5,6 +5,7 @@ from deneva_tpu.cc.no_wait import NoWait, WaitDie
 from deneva_tpu.cc.timestamp import Timestamp
 from deneva_tpu.cc.mvcc import Mvcc
 from deneva_tpu.cc.occ import Occ
+from deneva_tpu.cc.maat import Maat
 
 REGISTRY: dict[str, CCPlugin] = {}
 
@@ -19,6 +20,7 @@ register(WaitDie())
 register(Timestamp())
 register(Mvcc())
 register(Occ())
+register(Maat())
 
 
 def get(name: str) -> CCPlugin:

@@ -52,6 +52,25 @@ class CCPlugin:
     #: first timestamp forever (assigned only in the CL_QRY branch).
     new_ts_on_restart: bool = False
 
+    # --- multi-shard support (deneva_tpu/parallel/sharded.py) ---
+    #: db keys holding per-TXN-slot (B,) arrays that must travel with each
+    #: routed access entry to the owner shard (the CC metadata the reference
+    #: ships inside QueryMessage/AckMessage, message.h:341-363,165-183),
+    #: and be merged back at home with the given op after the exchange.
+    txn_db_fields: tuple[str, ...] = ()
+    txn_db_merge: dict = {}            # field -> "max" | "min"
+    #: db key whose (B,) value is the txn's commit timestamp shipped with
+    #: the commit exchange (MaaT's find_bound lower); None -> txn.ts
+    commit_ts_field: str | None = None
+
+    def home_commit_check(self, cfg: Config, db: dict, txn: TxnState,
+                          commit_try: jnp.ndarray) -> jnp.ndarray:
+        """Final home-side check after per-owner votes merge (the
+        coordinator's re-validation when all RACK_PREPs are in,
+        worker_thread.cpp:302-343).  Owners vote on local views; constraints
+        merged from different owners can still be jointly unsatisfiable."""
+        return commit_try
+
     def init_db(self, cfg: Config, n_rows: int, B: int, R: int) -> dict:
         return {}
 

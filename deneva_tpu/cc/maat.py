@@ -1,0 +1,200 @@
+"""MaaT dynamic timestamp-range validation (CC_ALG=MAAT) — rebuild of
+Maat + TimeTable + Row_maat (concurrency_control/maat.cpp:29-190,
+row_maat.cpp:99-314).
+
+State mapping
+-------------
+reference                                   this build
+TimeTable [lower,upper) hashed buckets  ->  maat_lower/maat_upper (B,) slots
+row timestamp_last_read/_last_write     ->  maat_lr/maat_lw (rows,) dense
+row uncommitted_reads/writes sets       ->  the granted live access entries
+txn greatest_read/write_timestamp       ->  maat_gr/maat_gw (B,) snapshots
+                                            accumulated at access-grant time
+
+Accesses never block or abort (soft locks only, row_maat.cpp:99-164): the
+work phase grants everything, snapshotting greatest lr/lw seen.  All range
+arithmetic happens at validation/commit, one batched pass per tick:
+
+- case 1/3 (maat.cpp:46-48,68-70): lower > snapshot gw; for writers also
+  lower > snapshot gr.  Using access-time snapshots (not commit-time values)
+  matters: a writer that committed AFTER my access must push my upper DOWN
+  (I read the old value), not my lower up.
+- cases 2/4/5 against VALIDATED/COMMITTED neighbors (maat.cpp:49-110):
+  committed neighbors already pushed my bounds at their commit (forward
+  validation below); same-tick finishers are serialized by ts and act
+  VALIDATED toward later finishers via per-row prefix reductions over their
+  pre-tick bounds.
+- neighbor squeeze at successful validation + commit-time forward
+  validation (maat.cpp:121-157, row_maat.cpp:208-307) are consolidated into
+  one pass — in a synchronous tick the live set at validation and at commit
+  is identical: for each committing txn T, live readers of rows T wrote get
+  upper <= T.lower-1, and live writers of rows T read or wrote get
+  lower >= T.upper+1.
+- commit_ts = final lower (find_bound, maat.cpp:176-190); rows written get
+  lw = max(lw, commit_ts), rows read get lr = max(lr, commit_ts).
+
+Known divergences (documented, parity measured by abort rates): snapshot
+*sets* are not tracked per txn — the live join at validation approximates
+"was in the row's uncommitted set at my access time"; the reference's
+commit-time push of unknown-writer uppers (row_maat.cpp:222-233), which
+orders writers it never observed BEFORE itself, is dropped in favor of the
+validation-side after-squeeze (both directions would conflict).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from deneva_tpu.cc.base import AccessDecision, CCPlugin
+from deneva_tpu.config import Config
+from deneva_tpu.engine.state import (BIG_TS, NULL_KEY, STATUS_RUNNING,
+                                     STATUS_WAITING, TxnState, make_entries)
+from deneva_tpu.ops import segment as seg
+
+
+class Maat(CCPlugin):
+    name = "MAAT"
+    new_ts_on_restart = True
+    # bounds/snapshots ride along with routed entries (the lower/upper the
+    # reference carries in Ack/Query messages, message.h:165-183) and merge
+    # back at home: ranges only ever tighten
+    txn_db_fields = ("maat_lower", "maat_upper", "maat_gw", "maat_gr")
+    txn_db_merge = {"maat_lower": "max", "maat_upper": "min",
+                    "maat_gw": "max", "maat_gr": "max"}
+    commit_ts_field = "maat_lower"
+
+    def init_db(self, cfg: Config, n_rows: int, B: int, R: int) -> dict:
+        return {
+            "maat_lr": jnp.zeros(n_rows, jnp.int32),
+            "maat_lw": jnp.zeros(n_rows, jnp.int32),
+            "maat_lower": jnp.zeros(B, jnp.int32),
+            "maat_upper": jnp.full(B, BIG_TS, jnp.int32),
+            "maat_gw": jnp.zeros(B, jnp.int32),
+            "maat_gr": jnp.zeros(B, jnp.int32),
+        }
+
+    def on_start(self, cfg: Config, db: dict, txn: TxnState, started):
+        # time_table.init (worker_thread.cpp:504-508): [0, MAX), fresh snaps
+        return {**db,
+                "maat_lower": jnp.where(started, 0, db["maat_lower"]),
+                "maat_upper": jnp.where(started, BIG_TS, db["maat_upper"]),
+                "maat_gw": jnp.where(started, 0, db["maat_gw"]),
+                "maat_gr": jnp.where(started, 0, db["maat_gr"])}
+
+    def access(self, cfg: Config, db: dict, txn: TxnState, active):
+        B, R = txn.keys.shape
+        ent = make_entries(txn, active, window=cfg.acquire_window)
+        req = ent.req.reshape(B, R)
+        n_rows = db["maat_lr"].shape[0]
+        k = jnp.clip(txn.keys, 0, n_rows - 1)
+
+        # snapshot greatest last-write/last-read over this tick's granted
+        # accesses (row_maat.cpp:131-136,183-189); everything is granted
+        lw_k = jnp.where(req, db["maat_lw"][k], 0)
+        lr_k = jnp.where(req & txn.is_write, db["maat_lr"][k], 0)
+        gw = jnp.maximum(db["maat_gw"], lw_k.max(axis=1))
+        gr = jnp.maximum(db["maat_gr"], lr_k.max(axis=1))
+
+        z = jnp.zeros((B, R), dtype=bool)
+        return (AccessDecision(grant=req, wait=z, abort=z),
+                {**db, "maat_gw": gw, "maat_gr": gr})
+
+    def validate(self, cfg: Config, db: dict, txn: TxnState, finishing, tick):
+        B, R = txn.keys.shape
+        n = B * R
+
+        # entry view: all granted accesses of live txns (the soft-lock sets)
+        live_txn = ((txn.status == STATUS_RUNNING)
+                    | (txn.status == STATUS_WAITING))
+        ridx = jnp.arange(R, dtype=jnp.int32)[None, :]
+        granted = (ridx < txn.cursor[:, None]) & (ridx < txn.n_req[:, None])
+        ent_live = (live_txn[:, None] & granted).reshape(-1)
+        fin_e = (finishing[:, None] & granted).reshape(-1)
+
+        key = jnp.where(ent_live, txn.keys.reshape(-1), NULL_KEY)
+        ts = jnp.broadcast_to(txn.ts[:, None], (B, R)).reshape(-1)
+        iw = txn.is_write.reshape(-1)
+        tx = jnp.broadcast_to(
+            jnp.arange(B, dtype=jnp.int32)[:, None], (B, R)).reshape(-1)
+
+        lo_e = db["maat_lower"][tx]
+        up_e = db["maat_upper"][tx]
+
+        (skey, sts), (s_iw, s_fin, s_tx, s_lo, s_up, s_orig) = seg.sort_by(
+            (key, ts),
+            (iw, fin_e, tx, lo_e, up_e, jnp.arange(n, dtype=jnp.int32)))
+        starts = seg.segment_starts(skey)
+
+        # same-tick earlier finishers act VALIDATED (cases 2/4/5):
+        fw = s_fin & s_iw     # finisher writes
+        fr = s_fin & ~s_iw    # finisher reads
+        # case 2: I read k -> upper <= (earlier finisher-writer lower) - 1
+        c2 = seg.seg_prefix_min(jnp.where(fw, s_lo - 1, BIG_TS), starts, BIG_TS)
+        # case 4: I write k -> lower >= (earlier finisher-reader upper) + 1
+        c4 = seg.seg_prefix_max(jnp.where(fr, s_up + 1, 0), starts, 0)
+        # case 5: I write k -> lower >= (earlier finisher-writer upper) + 1
+        c5 = seg.seg_prefix_max(jnp.where(fw, s_up + 1, 0), starts, 0)
+
+        unsort = lambda x, init: jnp.full(n, init, jnp.int32).at[s_orig].set(x)
+        c2_e = unsort(jnp.where(s_fin & ~s_iw, c2, BIG_TS), BIG_TS).reshape(B, R)
+        c45_e = unsort(jnp.where(s_fin & s_iw, jnp.maximum(c4, c5), 0),
+                       0).reshape(B, R)
+
+        lower = jnp.maximum(db["maat_lower"], db["maat_gw"] + 1)
+        has_write = (txn.is_write & granted).any(axis=1)
+        lower = jnp.where(finishing & has_write,
+                          jnp.maximum(lower, db["maat_gr"] + 1), lower)
+        lower = jnp.maximum(lower, c45_e.max(axis=1))
+        upper = jnp.minimum(db["maat_upper"], c2_e.min(axis=1))
+
+        ok = finishing & (lower < upper)
+
+        # neighbor squeeze for successful validators (maat.cpp:121-157 +
+        # row_maat commit-time forward validation, consolidated):
+        ok_e_sorted = ok[s_tx] & s_fin
+        run_e_sorted = (skey != NULL_KEY) & ~s_fin  # live, not finishing
+        lower_f = lower[s_tx]
+        upper_f = upper[s_tx]
+        # per row: min lower over committing writers; max upper over
+        # committing touchers (read or write)
+        min_lo_w = seg.seg_min_where(lower_f, ok_e_sorted & s_iw, starts, BIG_TS)
+        max_up_t = seg.seg_max_where(upper_f, ok_e_sorted, starts, 0)
+        max_up_w = seg.seg_max_where(upper_f, ok_e_sorted & s_iw, starts, 0)
+
+        # running readers of a committed-written row: upper <= min_lo_w - 1
+        new_up = jnp.where(run_e_sorted & ~s_iw & (min_lo_w < BIG_TS),
+                           min_lo_w - 1, BIG_TS)
+        # running writers of a row a committer touched: lower >= max_up + 1
+        # (writers of my read rows AND of my write rows form the after set)
+        cap = jnp.where(run_e_sorted & s_iw & (max_up_t > 0),
+                        max_up_t + 1, 0)
+
+        upper_arr = db["maat_upper"].at[s_tx].min(new_up)
+        lower_arr = db["maat_lower"].at[s_tx].max(cap)
+        # also persist the validators' own tightened bounds
+        upper_arr = jnp.where(finishing, upper, upper_arr)
+        lower_arr = jnp.where(finishing, lower, lower_arr)
+
+        return ok, {**db, "maat_lower": lower_arr, "maat_upper": upper_arr}
+
+    def home_commit_check(self, cfg: Config, db: dict, txn: TxnState,
+                          commit_try):
+        # find_bound at the coordinator (maat.cpp:176-190): per-owner votes
+        # check only locally-tightened ranges; the MERGED range can be empty
+        # (one owner raised lower past another owner's lowered upper)
+        return commit_try & (db["maat_lower"] < db["maat_upper"])
+
+    def on_commit(self, cfg: Config, db: dict, txn: TxnState, committed,
+                  commit_ts, tick):
+        # commit_timestamp = lower (find_bound); bump row lr/lw
+        B, R = txn.keys.shape
+        cts = db["maat_lower"]
+        ridx = jnp.arange(R, dtype=jnp.int32)[None, :]
+        acc = committed[:, None] & (ridx < txn.n_req[:, None])
+        wmask = (acc & txn.is_write).reshape(-1)
+        rmask = (acc & ~txn.is_write).reshape(-1)
+        keys = txn.keys.reshape(-1)
+        cts_e = jnp.broadcast_to(cts[:, None], (B, R)).reshape(-1)
+        lw = db["maat_lw"].at[keys].max(jnp.where(wmask, cts_e, 0), mode="drop")
+        lr = db["maat_lr"].at[keys].max(jnp.where(rmask, cts_e, 0), mode="drop")
+        return {**db, "maat_lw": lw, "maat_lr": lr}

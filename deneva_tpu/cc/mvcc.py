@@ -121,7 +121,7 @@ class Mvcc(CCPlugin):
         live = skey != NULL_KEY
         pending_w = live & s_iw & (s_held | (s_req & ~s_wab))
         # max pending-prewrite ts strictly before me in ts order
-        pref = _prefix_max_seg(jnp.where(pending_w, sts, 0), starts)
+        pref = seg.seg_prefix_max(jnp.where(pending_w, sts, 0), starts)
         pts = jnp.zeros_like(pref).at[s_orig].set(pref)
 
         r_wait = (pts > v_ts) & (pts > 0)
@@ -184,23 +184,3 @@ class Mvcc(CCPlugin):
         return {**db, "w_ring": w_ring, "r_ring": r_ring, "w_floor": w_floor}
 
 
-def _prefix_max_seg(vals: jnp.ndarray, starts: jnp.ndarray) -> jnp.ndarray:
-    """Exclusive per-segment running max of vals (0 where nothing before).
-
-    Segment-reset scan via an associative combine over (value, segment id).
-    """
-    n = vals.shape[0]
-    idx = jnp.arange(n, dtype=jnp.int32)
-    sid = seg.seg_ids(starts)
-
-    def combine(a, b):
-        av, aid = a
-        bv, bid = b
-        v = jnp.where(aid == bid, jnp.maximum(av, bv), bv)
-        return v, bid
-
-    incl, _ = jax.lax.associative_scan(combine, (vals, sid), axis=0)
-    # exclusive: value strictly before me within my segment
-    prev = jnp.where(idx == 0, 0, jnp.roll(incl, 1))
-    same_seg = jnp.where(idx == 0, False, jnp.roll(sid, 1) == sid)
-    return jnp.where(same_seg, prev, 0)

@@ -95,3 +95,39 @@ def seg_min_where(vals: jnp.ndarray, where: jnp.ndarray, starts: jnp.ndarray,
     """Segment-wide min of vals over elements with `where` set; `big` if none."""
     masked = jnp.where(where, vals, big)
     return seg_reduce(masked, starts, "min")
+
+
+def seg_max_where(vals: jnp.ndarray, where: jnp.ndarray, starts: jnp.ndarray,
+                  small: int) -> jnp.ndarray:
+    """Segment-wide max of vals over elements with `where` set; `small` if none."""
+    masked = jnp.where(where, vals, small)
+    return seg_reduce(masked, starts, "max")
+
+
+def _seg_scan(vals: jnp.ndarray, starts: jnp.ndarray, op, identity):
+    """Exclusive per-segment scan with combine `op` (associative)."""
+    n = vals.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    sid = seg_ids(starts)
+
+    def combine(a, b):
+        av, aid = a
+        bv, bid = b
+        return jnp.where(aid == bid, op(av, bv), bv), bid
+
+    incl, _ = lax.associative_scan(combine, (vals, sid), axis=0)
+    prev = jnp.where(idx == 0, identity, jnp.roll(incl, 1))
+    same_seg = jnp.where(idx == 0, False, jnp.roll(sid, 1) == sid)
+    return jnp.where(same_seg, prev, identity)
+
+
+def seg_prefix_max(vals: jnp.ndarray, starts: jnp.ndarray,
+                   identity: int = 0) -> jnp.ndarray:
+    """Max over elements strictly before me in my segment (identity if none)."""
+    return _seg_scan(vals, starts, jnp.maximum, identity)
+
+
+def seg_prefix_min(vals: jnp.ndarray, starts: jnp.ndarray,
+                   identity: int) -> jnp.ndarray:
+    """Min over elements strictly before me in my segment (identity if none)."""
+    return _seg_scan(vals, starts, jnp.minimum, identity)

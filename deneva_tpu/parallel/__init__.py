@@ -1,0 +1,4 @@
+from deneva_tpu.parallel import routing
+from deneva_tpu.parallel.sharded import ShardedEngine
+
+__all__ = ["routing", "ShardedEngine"]

@@ -1,0 +1,81 @@
+"""Fixed-capacity all_to_all routing of access entries to row-owner shards.
+
+The reference ships work between nodes with nanomsg messages batched per
+destination (transport/msg_thread.cpp:44-117, RQRY work-shipping
+message.h:341-363).  The TPU rebuild exchanges dense (N, C) tensors over ICI
+instead: each tick, every node packs its live access entries into per-
+destination lanes of capacity C and one jax.lax.all_to_all delivers them to
+the owners; decisions travel back through the inverse exchange.
+
+Capacity C bounds the per-(src,dst) traffic like a real NIC: entries are
+packed held-locks-first (dropping a held entry would hide a lock from its
+owner), and any txn whose entry overflows is aborted by its home node this
+tick — correct (its writes never apply) and rare at sane capacity factors;
+counted in stats as route overflow aborts.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deneva_tpu.engine.state import NULL_KEY
+from deneva_tpu.ops import segment as seg
+
+#: fill values per routed field
+FILL = {"key": NULL_KEY}
+
+
+def pack_by_dest(dest: jnp.ndarray, prio: jnp.ndarray, live: jnp.ndarray,
+                 n_nodes: int, cap: int, fields: dict[str, jnp.ndarray]):
+    """Pack entries into (N, C) per-destination lanes.
+
+    dest/prio/live: (n,) — destination shard, packing priority (smaller
+    packs first; pass held-first composite), liveness.
+    fields: name -> (n,) arrays to route.
+
+    Returns (send: dict name -> (N, C), orig: (N, C) int32 original entry
+    index or -1, overflow: (n,) bool mask of live entries that did not fit).
+    """
+    n = dest.shape[0]
+    d = jnp.where(live, dest, n_nodes).astype(jnp.int32)
+    idx = jnp.arange(n, dtype=jnp.int32)
+    (sd, _), (sidx,) = seg.sort_by((d, prio), (idx,))
+    starts = seg.segment_starts(sd)
+    pos = seg.pos_in_segment(starts)
+    kept = (sd < n_nodes) & (pos < cap)
+    slot = jnp.where(kept, sd * cap + pos, n_nodes * cap)
+
+    send = {}
+    for name, vals in fields.items():
+        fill = FILL.get(name, 0)
+        buf = jnp.full(n_nodes * cap, fill, vals.dtype)
+        send[name] = buf.at[slot].set(vals[sidx], mode="drop").reshape(
+            n_nodes, cap)
+    orig = jnp.full(n_nodes * cap, -1, jnp.int32).at[slot].set(
+        sidx, mode="drop").reshape(n_nodes, cap)
+
+    ovf_sorted = (sd < n_nodes) & (pos >= cap)
+    overflow = jnp.zeros(n, dtype=bool).at[sidx].set(ovf_sorted)
+    return send, orig, overflow
+
+
+def exchange(send: dict[str, jnp.ndarray], axis_name: str):
+    """all_to_all each (N, C) field: row i of the result holds what node i
+    sent to me (the batched RQRY delivery)."""
+    return {name: jax.lax.all_to_all(buf, axis_name, split_axis=0,
+                                     concat_axis=0)
+            for name, buf in send.items()}
+
+
+def unpack(results: dict[str, jnp.ndarray], orig: jnp.ndarray, n: int,
+           defaults: dict[str, jnp.ndarray]):
+    """Scatter returned (N, C) per-entry results back to original (n,) entry
+    order using the packing permutation.  `defaults` provides the value for
+    entries that were never shipped (overflow / dead)."""
+    flat_orig = orig.reshape(-1)
+    tgt = jnp.where(flat_orig >= 0, flat_orig, n)
+    out = {}
+    for name, buf in results.items():
+        out[name] = defaults[name].at[tgt].set(buf.reshape(-1), mode="drop")
+    return out

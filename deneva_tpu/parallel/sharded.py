@@ -1,0 +1,513 @@
+"""Multi-shard SPMD engine — the rebuild of NODE_CNT distributed execution.
+
+The reference runs NODE_CNT server processes connected by nanomsg sockets;
+remote accesses ship RQRY messages to the partition's owner, 2PC gathers
+RACK votes, and commit ships RFIN (SURVEY.md §3.2).  Here the cluster is a
+``jax.sharding.Mesh`` axis ``"node"``: every node owns ``rows/N`` rows
+(key % N striping, the rebuild of GET_NODE_ID / key_to_part,
+global.h:293-306, ycsb_wl.cpp:70-74) and ``B`` home transaction slots, and
+one scheduler tick is a single SPMD program with three all_to_all exchanges
+over ICI:
+
+  A  (RQRY):      every live access entry (held + requested, plus entries of
+                  finishing txns flagged for validation) routes to its row's
+                  owner; the owner materializes them as *virtual
+                  single-access transactions* and runs the UNCHANGED
+                  single-shard CC plugin kernels on them — lock arbitration
+                  and commit-validation votes are per-row decomposable, so
+                  owning the row makes the node the natural serialization
+                  point (the per-row latch of storage/row.cpp, without the
+                  latch).
+  A' (RQRY_RSP / RACK_PREP): per-entry grant/wait/abort decisions and
+                  validation votes return through the inverse all_to_all;
+                  the home node AND-gathers votes (the psum-style 2PC vote
+                  collection) and advances cursors.
+  B  (RFIN):      committed txns' accesses route to owners again to apply
+                  writes and CC commit metadata (wts bumps, version
+                  inserts, MaaT lr/lw).  A txn whose RFIN entries overflow
+                  the exchange capacity simply stays in the finishing state
+                  and retries next tick (commit deferral, never loss).
+
+Per-txn CC metadata (MaaT bounds) rides along with entries and merges back
+monotonically (ranges only tighten) — the rebuild of CC payloads inside
+Query/Ack messages (message.h:341-363,165-183).
+
+The 2PC prepare/finish rounds are not extra ticks: exchange A carries the
+prepare votes and exchange B the finish, so a multi-partition commit costs
+exactly one tick of latency — the batched equivalent of the reference's
+message round-trips happening for all txns at once.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from deneva_tpu import cc as cc_registry
+from deneva_tpu.config import Config, YCSB
+from deneva_tpu.engine.scheduler import (STAT_KEYS_F32, STAT_KEYS_I32,
+                                         _zeros_stats)
+from deneva_tpu.engine.state import (BIG_TS, NULL_KEY, STATUS_BACKOFF,
+                                     STATUS_FREE, STATUS_RUNNING,
+                                     STATUS_WAITING, TxnState)
+from deneva_tpu.parallel import routing
+from deneva_tpu.workloads import ycsb
+from deneva_tpu.workloads.base import QueryPool
+
+AXIS = "node"
+
+SHARD_STAT_KEYS = ("route_overflow_abort_cnt", "commit_defer_cnt",
+                   "remote_entry_cnt")
+
+
+class ShardState(NamedTuple):
+    txn: TxnState              # (B, R) home transactions
+    db: dict                   # per-row (rows/N) + per-txn (B,) CC arrays
+    data: jnp.ndarray          # (rows/N,) local rows (increment oracle)
+    stats: dict
+    tick: jnp.ndarray
+    pool_cursor: jnp.ndarray
+    ts_counter: jnp.ndarray
+
+
+def _flags(iw, held, req, fin):
+    return (iw.astype(jnp.int32) | (held.astype(jnp.int32) << 1)
+            | (req.astype(jnp.int32) << 2) | (fin.astype(jnp.int32) << 3))
+
+
+def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
+                      cap: int):
+    B = cfg.batch_size
+    Q = pool_dev["keys"].shape[0]
+    R = pool_dev["keys"].shape[1]
+    node_stride = n_nodes
+
+    def bump(stats, key, amount, measuring):
+        inc = jnp.where(measuring, amount, 0).astype(stats[key].dtype)
+        return {**stats, key: stats[key] + inc}
+
+    def tick_fn(state: ShardState, node_id) -> ShardState:
+        txn, db, data, stats = state.txn, state.db, state.data, state.stats
+        t = state.tick
+        measuring = t >= cfg.warmup_ticks
+
+        # ---- 1. backoff expiry + admission (home-local) ----
+        expire = (txn.status == STATUS_BACKOFF) & (txn.backoff_until <= t)
+        status = jnp.where(expire, STATUS_RUNNING, txn.status)
+        start_tick = jnp.where(expire, t, txn.start_tick)
+
+        free = status == STATUS_FREE
+        frank = jnp.cumsum(free.astype(jnp.int32)) - free.astype(jnp.int32)
+        n_free = jnp.sum(free.astype(jnp.int32))
+        pidx = (state.pool_cursor + frank) % Q
+
+        keys = jnp.where(free[:, None], pool_dev["keys"][pidx], txn.keys)
+        is_write = jnp.where(free[:, None], pool_dev["is_write"][pidx],
+                             txn.is_write)
+        n_req = jnp.where(free, pool_dev["n_req"][pidx], txn.n_req)
+
+        redraw = plugin.new_ts_on_restart or cfg.restart_new_ts
+        need_ts = free | (expire if redraw else jnp.zeros_like(free))
+        trank = jnp.cumsum(need_ts.astype(jnp.int32)) - need_ts.astype(jnp.int32)
+        # globally unique, node-interleaved timestamps
+        ts = jnp.where(need_ts,
+                       (state.ts_counter + trank) * node_stride + node_id,
+                       txn.ts)
+        ts_counter = state.ts_counter + jnp.sum(need_ts.astype(jnp.int32))
+
+        status = jnp.where(free, STATUS_RUNNING, status)
+        cursor = jnp.where(free, 0, txn.cursor)
+        restarts = jnp.where(free, 0, txn.restarts)
+        pool_idx = jnp.where(free, pidx, txn.pool_idx)
+        start_tick = jnp.where(free, t, start_tick)
+        first_start_tick = jnp.where(free, t, txn.first_start_tick)
+        stats = bump(stats, "local_txn_start_cnt", n_free, measuring)
+
+        txn = TxnState(status=status, cursor=cursor, ts=ts, pool_idx=pool_idx,
+                       restarts=restarts, backoff_until=txn.backoff_until,
+                       start_tick=start_tick, first_start_tick=first_start_tick,
+                       keys=keys, is_write=is_write, n_req=n_req)
+        db = plugin.on_start(cfg, db, txn, free | expire)
+
+        # ---- 2. build + route entries (exchange A) ----
+        from deneva_tpu.config import READ_COMMITTED, READ_UNCOMMITTED
+        from deneva_tpu.engine.state import make_entries
+        active = (txn.status == STATUS_RUNNING) | (txn.status == STATUS_WAITING)
+        ridx = jnp.broadcast_to(jnp.arange(R, dtype=jnp.int32), (B, R))
+        finishing = (txn.status == STATUS_RUNNING) & (txn.cursor >= txn.n_req)
+        ent = make_entries(
+            txn, active,
+            read_locks_held=(cfg.isolation_level not in (READ_COMMITTED,
+                                                         READ_UNCOMMITTED)),
+            window=cfg.acquire_window)
+        held, req = ent.held, ent.req
+        fin2 = finishing[:, None] & (ridx < txn.n_req[:, None])
+        live_e = held | req
+
+        key_g = txn.keys.reshape(-1)
+        dest = jnp.where(live_e, key_g % n_nodes, n_nodes)
+        key_l = key_g // n_nodes
+        ts_e = ent.ts
+        fields = {
+            "key": jnp.where(live_e, key_l, NULL_KEY),
+            "ts": ts_e,
+            "flags": _flags(ent.is_write, held, req, fin2.reshape(-1)),
+            "start_tick": jnp.broadcast_to(
+                txn.start_tick[:, None], (B, R)).reshape(-1),
+        }
+        for f in plugin.txn_db_fields:
+            fields[f] = jnp.broadcast_to(db[f][:, None], (B, R)).reshape(-1)
+
+        # pack held entries first: dropping a held lock entry would hide it
+        # from the owner; a dropped entry aborts its txn instead (a boolean
+        # key, not an additive ts offset — that would overflow int32)
+        prio = (~held).astype(jnp.int32)
+        send, orig, overflow = routing.pack_by_dest(
+            dest, prio, live_e, n_nodes, cap, fields)
+        stats = bump(stats, "remote_entry_cnt",
+                     jnp.sum((live_e & (dest != node_id)).astype(jnp.int32)),
+                     measuring)
+
+        recv = routing.exchange(send, AXIS)
+
+        # ---- 3. owner side: virtual txns -> plugin kernels ----
+        Bv = n_nodes * cap
+        r_key = recv["key"].reshape(-1)
+        r_live = r_key != NULL_KEY
+        r_flags = recv["flags"].reshape(-1)
+        r_iw = (r_flags & 1) == 1
+        r_held = (r_flags >> 1) & 1 == 1
+        r_fin = ((r_flags >> 3) & 1 == 1) & r_live
+
+        vtxn = TxnState(
+            status=jnp.where(r_live, STATUS_RUNNING, STATUS_FREE),
+            cursor=jnp.where(r_held, 1, 0),
+            ts=recv["ts"].reshape(-1),
+            pool_idx=jnp.zeros(Bv, jnp.int32),
+            restarts=jnp.zeros(Bv, jnp.int32),
+            backoff_until=jnp.zeros(Bv, jnp.int32),
+            start_tick=recv["start_tick"].reshape(-1),
+            first_start_tick=recv["start_tick"].reshape(-1),
+            keys=r_key[:, None],
+            is_write=r_iw[:, None],
+            n_req=jnp.where(r_live, 1, 0),
+        )
+        vdb = dict(db)
+        for f in plugin.txn_db_fields:
+            vdb[f] = recv[f].reshape(-1)
+
+        vactive = r_live
+        dec, vdb = plugin.access(cfg, vdb, vtxn, vactive)
+        votes, vdb = plugin.validate(cfg, vdb, vtxn, r_fin, t)
+
+        decbits = (dec.grant.reshape(-1).astype(jnp.int32)
+                   | (dec.wait.reshape(-1).astype(jnp.int32) << 1)
+                   | (dec.abort.reshape(-1).astype(jnp.int32) << 2)
+                   | (votes.astype(jnp.int32) << 3))
+        back = {"decbits": decbits.reshape(n_nodes, cap)}
+        for f in plugin.txn_db_fields:
+            back[f] = vdb[f].reshape(n_nodes, cap)
+        # keep owner-updated ROW arrays; txn-keyed fields travel back instead
+        db = {**db, **{k: v for k, v in vdb.items()
+                       if k not in plugin.txn_db_fields}}
+
+        ret = routing.exchange(back, AXIS)
+
+        # ---- 4. home: unpack decisions, advance, vote-gather ----
+        nE = B * R
+        defaults = {"decbits": jnp.zeros(nE + 1, jnp.int32).at[:].set(
+            jnp.int32(1 << 3))}  # unshipped: no decision, vote=yes
+        for f in plugin.txn_db_fields:
+            defaults[f] = jnp.concatenate(
+                [jnp.broadcast_to(db[f][:, None], (B, R)).reshape(-1),
+                 jnp.zeros(1, db[f].dtype)])
+        got = routing.unpack(ret, orig, nE, defaults)
+        decb = got["decbits"][:nE].reshape(B, R)
+        grant = (decb & 1) == 1
+        wait_e = ((decb >> 1) & 1) == 1
+        abort_e = ((decb >> 2) & 1) == 1
+        vote_e = ((decb >> 3) & 1) == 1
+
+        for f in plugin.txn_db_fields:
+            per_e = got[f][:nE].reshape(B, R)
+            if plugin.txn_db_merge[f] == "max":
+                db = {**db, f: jnp.maximum(db[f], per_e.max(axis=1))}
+            else:
+                db = {**db, f: jnp.minimum(db[f], per_e.min(axis=1))}
+
+        ovf_txn = jnp.any(overflow.reshape(B, R), axis=1)
+        stats = bump(stats, "route_overflow_abort_cnt",
+                     jnp.sum((ovf_txn & active).astype(jnp.int32)), measuring)
+
+        votes_ok = jnp.all(vote_e | ~fin2, axis=1)
+        commit_try = finishing & votes_ok & ~ovf_txn
+        vabort = (finishing & ~votes_ok) | (ovf_txn & active)
+
+        # cursor advance over granted prefix (as in the single-shard tick)
+        ok = grant | (ridx < cur) | (ridx >= txn.n_req[:, None])
+        prefix = jnp.cumprod(ok.astype(jnp.int32), axis=1)
+        new_cursor = jnp.minimum(jnp.sum(prefix, axis=1), txn.n_req)
+        fail_pos = jnp.minimum(new_cursor, R - 1)[:, None]
+        at_fail = lambda m: jnp.take_along_axis(m, fail_pos, axis=1)[:, 0]
+        has_req = active & (txn.cursor < txn.n_req) & ~vabort
+        blocked = has_req & (new_cursor < txn.n_req)
+        wait = blocked & at_fail(wait_e) & ~vabort
+        abort_now = (blocked & at_fail(abort_e)) | vabort
+
+        cursor = jnp.where(has_req & ~abort_now, new_cursor, txn.cursor)
+        status = jnp.where(has_req & (new_cursor > txn.cursor),
+                           STATUS_RUNNING, txn.status)
+        status = jnp.where(wait, STATUS_WAITING, status)
+        stats = bump(stats, "twopl_wait_cnt",
+                     jnp.sum(wait.astype(jnp.int32)), measuring)
+
+        # ---- 5. commit exchange (B / RFIN): apply at owners ----
+        cts = db[plugin.commit_ts_field] if plugin.commit_ts_field else txn.ts
+        commit_e = (commit_try[:, None] & (ridx < txn.n_req[:, None])).reshape(-1)
+        fieldsB = {
+            "key": jnp.where(commit_e, key_l, NULL_KEY),
+            "cts": jnp.broadcast_to(cts[:, None], (B, R)).reshape(-1),
+            "iw": txn.is_write.reshape(-1).astype(jnp.int32),
+        }
+        sendB, origB, ovfB = routing.pack_by_dest(
+            dest, ts_e, commit_e, n_nodes, cap, fieldsB)
+        ovfB_txn = jnp.any(ovfB.reshape(B, R), axis=1)
+        commit = commit_try & ~ovfB_txn          # deferred txns retry RFIN
+        stats = bump(stats, "commit_defer_cnt",
+                     jnp.sum(ovfB_txn.astype(jnp.int32)), measuring)
+        # re-gather the final commit flag so deferred txns' shipped entries
+        # are ignored by the owner (no repack needed)
+        cflag_flat = jnp.concatenate(
+            [(commit[:, None] & (ridx < txn.n_req[:, None])).reshape(-1),
+             jnp.zeros(1, bool)])
+        oB = origB.reshape(-1)
+        sendB["commit"] = cflag_flat[jnp.where(oB >= 0, oB, nE)].astype(
+            jnp.int32).reshape(n_nodes, cap)
+
+        recvB = routing.exchange(sendB, AXIS)
+        rB_key = recvB["key"].reshape(-1)
+        rB_commit = (recvB["commit"].reshape(-1) == 1) & (rB_key != NULL_KEY)
+        rB_iw = recvB["iw"].reshape(-1) == 1
+        rB_cts = recvB["cts"].reshape(-1)
+
+        vtxnB = TxnState(
+            status=jnp.where(rB_commit, STATUS_RUNNING, STATUS_FREE),
+            cursor=jnp.ones(Bv, jnp.int32),
+            ts=rB_cts,
+            pool_idx=jnp.zeros(Bv, jnp.int32),
+            restarts=jnp.zeros(Bv, jnp.int32),
+            backoff_until=jnp.zeros(Bv, jnp.int32),
+            start_tick=jnp.zeros(Bv, jnp.int32),
+            first_start_tick=jnp.zeros(Bv, jnp.int32),
+            keys=rB_key[:, None],
+            is_write=rB_iw[:, None],
+            n_req=jnp.where(rB_commit, 1, 0),
+        )
+        vdbB = dict(db)
+        if plugin.commit_ts_field:
+            vdbB[plugin.commit_ts_field] = rB_cts
+        vdbB = plugin.on_commit(cfg, vdbB, vtxnB, rB_commit,
+                                commit_ts=rB_cts, tick=t)
+        db = {**db, **{k: v for k, v in vdbB.items()
+                       if k not in plugin.txn_db_fields
+                       and k != plugin.commit_ts_field}}
+        data = data.at[rB_key].add(
+            (rB_commit & rB_iw).astype(jnp.int32), mode="drop")
+
+        # ---- 6. commit/abort bookkeeping (home) ----
+        n_commit = jnp.sum(commit.astype(jnp.int32))
+        stats = bump(stats, "txn_cnt", n_commit, measuring)
+        stats = bump(stats, "write_cnt", jnp.sum(
+            (commit[:, None] & txn.is_write
+             & (ridx < txn.n_req[:, None])).astype(jnp.int32)), measuring)
+        stats = bump(stats, "unique_txn_abort_cnt",
+                     jnp.sum((commit & (txn.restarts > 0)).astype(jnp.int32)),
+                     measuring)
+        stats = bump(stats, "txn_run_time_ticks",
+                     jnp.sum(jnp.where(commit, t - txn.start_tick, 0)),
+                     measuring)
+        stats = bump(stats, "txn_total_time_ticks",
+                     jnp.sum(jnp.where(commit, t - txn.first_start_tick, 0)),
+                     measuring)
+        status = jnp.where(commit, STATUS_FREE, status)
+
+        stats = bump(stats, "total_txn_abort_cnt",
+                     jnp.sum(abort_now.astype(jnp.int32)), measuring)
+        shift = jnp.minimum(txn.restarts, 16)
+        penalty = jnp.where(
+            jnp.asarray(cfg.backoff),
+            jnp.minimum(cfg.abort_penalty_ticks * (1 << shift),
+                        cfg.abort_penalty_max_ticks),
+            cfg.abort_penalty_ticks).astype(jnp.int32)
+        status = jnp.where(abort_now, STATUS_BACKOFF, status)
+        cursor = jnp.where(abort_now, 0, cursor)
+        backoff_until = jnp.where(abort_now, t + penalty, txn.backoff_until)
+        restarts2 = jnp.where(abort_now, txn.restarts + 1, txn.restarts)
+        txn = txn._replace(status=status, cursor=cursor,
+                           backoff_until=backoff_until, restarts=restarts2)
+        db = plugin.on_abort(cfg, db, txn, abort_now)
+
+        # ---- 7. global ts rebase (all nodes together over ICI) ----
+        limit = jnp.int32((3 << 29) // node_stride)
+        by = jnp.int32((1 << 30) // node_stride)
+        global_max = jax.lax.pmax(ts_counter, AXIS)
+
+        def _rebase(op):
+            txn_, db_, tsc = op
+            txn_ = txn_._replace(
+                ts=jnp.maximum(txn_.ts - by * node_stride, 1))
+            db_ = plugin.on_ts_rebase(cfg, db_, by * node_stride)
+            return txn_, db_, tsc - by
+
+        txn, db, ts_counter = jax.lax.cond(
+            global_max > limit, _rebase, lambda op: op, (txn, db, ts_counter))
+
+        stats = bump(stats, "measured_ticks", 1, measuring)
+        return ShardState(txn=txn, db=db, data=data, stats=stats, tick=t + 1,
+                          pool_cursor=(state.pool_cursor + n_free) % Q,
+                          ts_counter=ts_counter)
+
+    return tick_fn
+
+
+class ShardedEngine:
+    """NODE_CNT-way sharded engine over a jax Mesh (one device per node)."""
+
+    def __init__(self, cfg: Config, pool: QueryPool | None = None,
+                 devices=None):
+        assert cfg.node_cnt >= 1
+        assert cfg.part_cnt == cfg.node_cnt, "part striping == node striping"
+        self.cfg = cfg
+        self.plugin = cc_registry.get(cfg.cc_alg)
+        N = cfg.node_cnt
+        if pool is None:
+            if cfg.workload != YCSB:
+                raise NotImplementedError(cfg.workload)
+            pool = ycsb.gen_query_pool(cfg)
+        self.pool = pool
+        devices = devices if devices is not None else jax.devices()[:N]
+        assert len(devices) == N, (len(devices), N)
+        self.mesh = Mesh(np.array(devices), (AXIS,))
+
+        # per-node query streams: node p serves queries with home_part == p
+        Qn = pool.size // N
+        sel = lambda a: np.stack([a[p::N][:Qn] for p in range(N)])
+        self.pool_stacked = {
+            "keys": jnp.asarray(sel(pool.keys)),
+            "is_write": jnp.asarray(sel(pool.is_write)),
+            "n_req": jnp.asarray(sel(pool.n_req)),
+        }
+
+        B, R = cfg.batch_size, pool.max_req
+        self.cap = max(int(B * R / N * cfg.route_capacity_factor), R)
+
+        self._tick_inner = None  # built lazily per pool shard inside spmd
+
+        def spmd_tick(state, pool_shard, node_idx):
+            st = jax.tree.map(lambda x: x[0], state)
+            pool_dev = {k: v[0] for k, v in pool_shard.items()}
+            tick = make_sharded_tick(self.cfg, self.plugin, pool_dev, N,
+                                     self.cap)
+            out = tick(st, node_idx[0])
+            return jax.tree.map(lambda x: x[None], out)
+
+        self._spmd_tick = spmd_tick
+        self._jit_tick = None
+
+    def init_state(self) -> ShardState:
+        cfg = self.cfg
+        N = cfg.node_cnt
+        B, R = cfg.batch_size, self.pool.max_req
+        rows_local = cfg.synth_table_size // N
+
+        def one():
+            db = self.plugin.init_db(cfg, rows_local, B, R)
+            return ShardState(
+                txn=TxnState.empty(B, R),
+                db=db,
+                data=jnp.zeros(rows_local, jnp.int32),
+                stats={**_zeros_stats(),
+                       **{k: jnp.zeros((), jnp.int32)
+                          for k in SHARD_STAT_KEYS}},
+                tick=jnp.zeros((), jnp.int32),
+                pool_cursor=jnp.zeros((), jnp.int32),
+                ts_counter=jnp.ones((), jnp.int32),
+            )
+
+        states = [one() for _ in range(N)]
+        stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *states)
+        return stacked
+
+    def _build(self):
+        if self._jit_tick is not None:
+            return
+        N = self.cfg.node_cnt
+        spec = P(AXIS)
+        node_idx = jnp.arange(N, dtype=jnp.int32)
+        f = shard_map(
+            self._spmd_tick, mesh=self.mesh,
+            in_specs=(spec, spec, spec), out_specs=spec)
+        self._node_idx = node_idx
+        self._jit_tick = jax.jit(
+            lambda st: f(st, self.pool_stacked, self._node_idx),
+            donate_argnums=0)
+
+    def run(self, n_ticks: int, state: ShardState | None = None) -> ShardState:
+        self._build()
+        if state is None:
+            state = self.init_state()
+        for _ in range(n_ticks):
+            state = self._jit_tick(state)
+        return state
+
+    def run_compiled(self, n_ticks: int, state=None):
+        self._build()
+        if state is None:
+            state = self.init_state()
+        N = self.cfg.node_cnt
+        spec = P(AXIS)
+
+        def spmd_many(st, pool_shard, node_idx):
+            s = jax.tree.map(lambda x: x[0], st)
+            pool_dev = {k: v[0] for k, v in pool_shard.items()}
+            tick = make_sharded_tick(self.cfg, self.plugin, pool_dev, N,
+                                     self.cap)
+            s = jax.lax.fori_loop(0, n_ticks,
+                                  lambda _, x: tick(x, node_idx[0]), s)
+            return jax.tree.map(lambda x: x[None], s)
+
+        f = shard_map(spmd_many, mesh=self.mesh,
+                      in_specs=(spec, spec, spec), out_specs=spec)
+        return jax.jit(f, donate_argnums=0)(state, self.pool_stacked,
+                                            self._node_idx if
+                                            self._jit_tick else
+                                            jnp.arange(N, dtype=jnp.int32))
+
+    def summary(self, state: ShardState, wall_seconds: float | None = None
+                ) -> dict:
+        """Cluster-wide stats: per-node counters summed, like the scripts
+        summing per-node tput (plot_helper.py:49-68)."""
+        s = {k: float(np.asarray(v).sum()) for k, v in state.stats.items()}
+        s = {k: int(v) if k in STAT_KEYS_I32 + SHARD_STAT_KEYS else v
+             for k, v in s.items()}
+        commits = max(s["txn_cnt"], 1)
+        out = dict(s)
+        out["measured_ticks"] = int(np.asarray(state.stats["measured_ticks"]
+                                               ).max())
+        out["tput_per_tick"] = s["txn_cnt"] / max(out["measured_ticks"], 1)
+        out["abort_rate"] = s["total_txn_abort_cnt"] / (
+            s["total_txn_abort_cnt"] + commits)
+        out["avg_latency_ticks_short"] = s["txn_run_time_ticks"] / commits
+        out["avg_latency_ticks_long"] = s["txn_total_time_ticks"] / commits
+        if wall_seconds is not None:
+            out["tput"] = s["txn_cnt"] / wall_seconds
+        return out
+
+    def global_data_sum(self, state: ShardState) -> int:
+        return int(np.asarray(state.data).sum())

@@ -1,0 +1,89 @@
+"""Multi-shard engine tests on the virtual 8-device CPU mesh
+(the rebuild's TPORT_TYPE=IPC local mode, SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+import jax
+
+from deneva_tpu.config import Config
+from deneva_tpu.parallel.sharded import ShardedEngine
+from deneva_tpu.engine.scheduler import Engine
+
+ALGS = ["NO_WAIT", "WAIT_DIE", "TIMESTAMP", "MVCC", "OCC", "MAAT"]
+
+
+def shard_cfg(n, **kw):
+    base = dict(node_cnt=n, part_cnt=n, batch_size=32,
+                synth_table_size=1 << 12, req_per_query=4,
+                query_pool_size=1 << 10, zipf_theta=0.6, tup_read_perc=0.5,
+                warmup_ticks=0, mpr=1.0, part_per_txn=n)
+    base.update(kw)
+    return Config(**base)
+
+
+def test_two_nodes_conservation():
+    eng = ShardedEngine(shard_cfg(2))
+    st = eng.run(30)
+    s = eng.summary(st)
+    assert s["txn_cnt"] > 0
+    assert eng.global_data_sum(st) == s["write_cnt"]
+    assert s["remote_entry_cnt"] > 0     # cross-partition traffic happened
+
+
+@pytest.mark.parametrize("alg", ALGS)
+def test_all_algorithms_four_nodes(alg):
+    eng = ShardedEngine(shard_cfg(4, cc_alg=alg))
+    st = eng.run(40)
+    s = eng.summary(st)
+    assert s["txn_cnt"] > 0, s
+    assert eng.global_data_sum(st) == s["write_cnt"], s
+
+
+def test_read_only_multipartition_never_aborts():
+    eng = ShardedEngine(shard_cfg(4, txn_read_perc=1.0, zipf_theta=0.9))
+    st = eng.run(30)
+    s = eng.summary(st)
+    assert s["total_txn_abort_cnt"] == 0
+    assert s["txn_cnt"] > 0
+    assert eng.global_data_sum(st) == 0
+
+
+def test_eight_nodes_smoke():
+    eng = ShardedEngine(shard_cfg(8, batch_size=16))
+    st = eng.run(25)
+    s = eng.summary(st)
+    assert s["txn_cnt"] > 0
+    assert eng.global_data_sum(st) == s["write_cnt"]
+
+
+def test_capacity_overflow_aborts_not_corrupts():
+    # starve the exchange: capacity barely above R forces overflow aborts
+    cfg = shard_cfg(2, route_capacity_factor=0.05, zipf_theta=0.0)
+    eng = ShardedEngine(cfg)
+    st = eng.run(30)
+    s = eng.summary(st)
+    assert s["route_overflow_abort_cnt"] > 0
+    assert eng.global_data_sum(st) == s["write_cnt"]   # still exactly-once
+
+
+def test_single_node_sharded_close_to_single_shard():
+    cfg = shard_cfg(1, part_per_txn=1, mpr=0.0, batch_size=64,
+                    query_pool_size=1 << 10)
+    sh = ShardedEngine(cfg)
+    st = sh.run(40)
+    s_sh = sh.summary(st)
+    assert sh.global_data_sum(st) == s_sh["write_cnt"]
+
+    single = Engine(cfg)
+    s_si = single.summary(single.run(40))
+    # release timing differs by one tick across the exchange, so allow slack
+    assert s_sh["txn_cnt"] > 0.5 * s_si["txn_cnt"]
+
+
+def test_greedy_window_sharded():
+    eng = ShardedEngine(shard_cfg(4, acquire_window=4, zipf_theta=0.0,
+                                  synth_table_size=1 << 14))
+    st = eng.run(25)
+    s = eng.summary(st)
+    assert s["txn_cnt"] > 150
+    assert eng.global_data_sum(st) == s["write_cnt"]
