@@ -247,10 +247,14 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
 
         votes_ok = jnp.all(vote_e | ~fin2, axis=1)
         commit_try = finishing & votes_ok & ~ovf_txn
-        vabort = (finishing & ~votes_ok) | (ovf_txn & active)
+        # coordinator re-validation once all owner votes are merged
+        # (worker_thread.cpp:302-343): per-owner constraints may be jointly
+        # unsatisfiable (e.g. MaaT merged [lower,upper) emptied)
+        commit_try = plugin.home_commit_check(cfg, db, txn, commit_try)
+        vabort = (finishing & ~commit_try & ~ovf_txn) | (ovf_txn & active)
 
         # cursor advance over granted prefix (as in the single-shard tick)
-        ok = grant | (ridx < cur) | (ridx >= txn.n_req[:, None])
+        ok = grant | (ridx < txn.cursor[:, None]) | (ridx >= txn.n_req[:, None])
         prefix = jnp.cumprod(ok.astype(jnp.int32), axis=1)
         new_cursor = jnp.minimum(jnp.sum(prefix, axis=1), txn.n_req)
         fail_pos = jnp.minimum(new_cursor, R - 1)[:, None]
