@@ -6,6 +6,7 @@ from deneva_tpu.cc.timestamp import Timestamp
 from deneva_tpu.cc.mvcc import Mvcc
 from deneva_tpu.cc.occ import Occ
 from deneva_tpu.cc.maat import Maat
+from deneva_tpu.cc.calvin import Calvin
 
 REGISTRY: dict[str, CCPlugin] = {}
 
@@ -21,6 +22,7 @@ register(Timestamp())
 register(Mvcc())
 register(Occ())
 register(Maat())
+register(Calvin())
 
 
 def get(name: str) -> CCPlugin:

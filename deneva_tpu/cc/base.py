@@ -51,6 +51,16 @@ class CCPlugin:
     #: start_ts) re-draw a timestamp on every restart; WAIT_DIE keeps its
     #: first timestamp forever (assigned only in the CL_QRY branch).
     new_ts_on_restart: bool = False
+    #: Calvin: admission is gated to cfg.epoch_size fresh txns per tick
+    #: (the SEQ_BATCH_TIMER batch release, system/sequencer.cpp:283-326).
+    epoch_admission: bool = False
+    #: Calvin: a txn requests its whole access set every tick
+    #: (TxnManager::acquire_locks, ycsb_txn.cpp:49-88) instead of the
+    #: cursor window.
+    request_all: bool = False
+    #: Calvin: no abort path exists (row_lock.cpp:78-81); the sharded
+    #: engine defers instead of aborting on routing overflow.
+    never_aborts: bool = False
 
     # --- multi-shard support (deneva_tpu/parallel/sharded.py) ---
     #: db keys holding per-TXN-slot (B,) arrays that must travel with each

@@ -1,0 +1,62 @@
+"""CALVIN: deterministic, lock-based, abort-free execution.
+
+The reference's Calvin path (SURVEY.md §3.3) is three cooperating threads:
+
+- the sequencer batches client txns into 5 ms epochs and assigns each a
+  deterministic global id ``txn_id = node_id + node_cnt * seq``
+  (system/sequencer.cpp:207; SEQ_BATCH_TIMER config.h:348);
+- the lock thread walks the epoch's txns in id order and acquires ALL of a
+  txn's locks up front via the FIFO, never-aborting Row_lock CALVIN mode
+  (system/calvin_thread.cpp:40-100, row_lock.cpp:78-81,152-170;
+  TxnManager::acquire_locks benchmarks/ycsb_txn.cpp:49-88);
+- workers run the 6-phase machine (RW_ANALYSIS .. DONE) once all locks are
+  granted, forwarding local reads to the active nodes via RFWD messages
+  (benchmarks/ycsb_txn.cpp:255-353, system/txn.cpp:958-990), then release
+  locks and CALVIN_ACK the sequencer (worker_thread.cpp:127-137).
+
+TPU reformulation:
+
+- the epoch timer becomes per-tick admission of up to ``cfg.epoch_size``
+  fresh txns (``epoch_admission``): one scheduler tick = one sequencer batch
+  release, and the admission timestamp is the deterministic sequence number
+  (node-interleaved ``seq * node_cnt + node_id`` in the sharded engine —
+  exactly the reference's id formula);
+- lock acquisition requests a txn's ENTIRE access set every tick
+  (``request_all`` — the acquire_locks loop), arbitrated by the stateless
+  FIFO grant of cc/twopl.py: a write grants only at the head of its row's
+  live-entry order, a read only if no write precedes it, and nothing ever
+  aborts (``never_aborts``);
+- a txn executes (commits + applies writes) the tick after its last lock
+  grants, so the commit schedule is the deterministic frontier-by-frontier
+  traversal of the batch's conflict DAG — the property the sequencer +
+  sched_queue machinery exists to enforce;
+- in the sharded engine the per-tick entry exchange to row owners is the
+  forwarding fabric (RFWD): owners arbitrate their rows' FIFO order locally
+  and grant decisions flow home through the inverse all_to_all
+  (deneva_tpu/parallel/sharded.py).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from deneva_tpu.cc.base import AccessDecision, CCPlugin
+from deneva_tpu.cc import twopl
+from deneva_tpu.config import Config
+from deneva_tpu.engine.state import TxnState, make_entries
+
+
+class Calvin(CCPlugin):
+    name = "CALVIN"
+    epoch_admission = True   # sequencer batch release per tick
+    request_all = True       # acquire_locks() requests every access up front
+    never_aborts = True      # row_lock.cpp:78-81: Calvin mode never aborts
+
+    def access(self, cfg: Config, db: dict, txn: TxnState, active):
+        B, R = txn.keys.shape
+        # Calvin ignores isolation-level release-early hooks: locks are held
+        # from grant to wrapup regardless (system/txn.cpp:778-788).
+        ent = make_entries(txn, active, read_locks_held=True, window=R)
+        g, w, a = twopl.arbitrate(ent, "CALVIN")
+        return AccessDecision(grant=g.reshape(B, R), wait=w.reshape(B, R),
+                              abort=a.reshape(B, R)), db

@@ -97,6 +97,11 @@ def make_tick(cfg: Config, plugin, pool_dev: dict):
 
         # ---- 2. admission from query pool ----
         free = status == STATUS_FREE
+        if plugin.epoch_admission:
+            # sequencer batch release: at most epoch_size fresh txns per
+            # tick (SEQ_BATCH_TIMER analog, system/sequencer.cpp:283-326)
+            frank0 = jnp.cumsum(free.astype(jnp.int32)) - free.astype(jnp.int32)
+            free = free & (frank0 < cfg.epoch_size)
         frank = jnp.cumsum(free.astype(jnp.int32)) - free.astype(jnp.int32)
         n_free = jnp.sum(free.astype(jnp.int32))
         pidx = (state.pool_cursor + frank) % Q

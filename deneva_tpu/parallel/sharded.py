@@ -103,6 +103,10 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
         start_tick = jnp.where(expire, t, txn.start_tick)
 
         free = status == STATUS_FREE
+        if plugin.epoch_admission:
+            # sequencer batch release (SEQ_BATCH_TIMER, sequencer.cpp:283-326)
+            frank0 = jnp.cumsum(free.astype(jnp.int32)) - free.astype(jnp.int32)
+            free = free & (frank0 < cfg.epoch_size)
         frank = jnp.cumsum(free.astype(jnp.int32)) - free.astype(jnp.int32)
         n_free = jnp.sum(free.astype(jnp.int32))
         pidx = (state.pool_cursor + frank) % Q
@@ -143,9 +147,10 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
         finishing = (txn.status == STATUS_RUNNING) & (txn.cursor >= txn.n_req)
         ent = make_entries(
             txn, active,
-            read_locks_held=(cfg.isolation_level not in (READ_COMMITTED,
-                                                         READ_UNCOMMITTED)),
-            window=cfg.acquire_window)
+            read_locks_held=(plugin.request_all
+                             or cfg.isolation_level not in (READ_COMMITTED,
+                                                            READ_UNCOMMITTED)),
+            window=R if plugin.request_all else cfg.acquire_window)
         held, req = ent.held, ent.req
         fin2 = finishing[:, None] & (ridx < txn.n_req[:, None])
         live_e = held | req
@@ -242,7 +247,9 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
                 db = {**db, f: jnp.minimum(db[f], per_e.min(axis=1))}
 
         ovf_txn = jnp.any(overflow.reshape(B, R), axis=1)
-        stats = bump(stats, "route_overflow_abort_cnt",
+        stats = bump(stats,
+                     "commit_defer_cnt" if plugin.never_aborts
+                     else "route_overflow_abort_cnt",
                      jnp.sum((ovf_txn & active).astype(jnp.int32)), measuring)
 
         votes_ok = jnp.all(vote_e | ~fin2, axis=1)
@@ -251,7 +258,12 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
         # (worker_thread.cpp:302-343): per-owner constraints may be jointly
         # unsatisfiable (e.g. MaaT merged [lower,upper) emptied)
         commit_try = plugin.home_commit_check(cfg, db, txn, commit_try)
-        vabort = (finishing & ~commit_try & ~ovf_txn) | (ovf_txn & active)
+        if plugin.never_aborts:
+            # Calvin: a routing overflow defers the txn (retry next tick with
+            # the same sequence number) — the abort path must stay closed
+            vabort = jnp.zeros_like(finishing)
+        else:
+            vabort = (finishing & ~commit_try & ~ovf_txn) | (ovf_txn & active)
 
         # cursor advance over granted prefix (as in the single-shard tick)
         ok = grant | (ridx < txn.cursor[:, None]) | (ridx >= txn.n_req[:, None])
@@ -260,6 +272,9 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
         fail_pos = jnp.minimum(new_cursor, R - 1)[:, None]
         at_fail = lambda m: jnp.take_along_axis(m, fail_pos, axis=1)[:, 0]
         has_req = active & (txn.cursor < txn.n_req) & ~vabort
+        if plugin.never_aborts:
+            # deferred (overflowed) txns must not advance on partial info
+            has_req = has_req & ~ovf_txn
         blocked = has_req & (new_cursor < txn.n_req)
         wait = blocked & at_fail(wait_e) & ~vabort
         abort_now = (blocked & at_fail(abort_e)) | vabort
@@ -410,6 +425,13 @@ class ShardedEngine:
 
         B, R = cfg.batch_size, pool.max_req
         self.cap = max(int(B * R / N * cfg.route_capacity_factor), R)
+        if self.plugin.never_aborts:
+            # Calvin has no abort path, and a dropped HELD entry would be
+            # invisible to the row owner — another writer could grant and
+            # break the deterministic FIFO schedule.  Size the exchange for
+            # the worst case (all of a node's B*R entries to one dest) so
+            # overflow is structurally impossible.
+            self.cap = B * R
 
         self._tick_inner = None  # built lazily per pool shard inside spmd
 
