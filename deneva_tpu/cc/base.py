@@ -72,6 +72,11 @@ class CCPlugin:
     #: db key whose (B,) value is the txn's commit timestamp shipped with
     #: the commit exchange (MaaT's find_bound lower); None -> txn.ts
     commit_ts_field: str | None = None
+    #: MaaT: the sharded engine ships each entry's ACCESS tick
+    #: (start_tick + ridx // window) in the start_tick field so the owner's
+    #: directional squeeze sees true per-row access order (single-access
+    #: virtual txns have ridx 0).
+    ship_access_tick: bool = False
 
     def home_commit_check(self, cfg: Config, db: dict, txn: TxnState,
                           commit_try: jnp.ndarray) -> jnp.ndarray:

@@ -43,6 +43,7 @@ validation-side after-squeeze (both directions would conflict).
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from deneva_tpu.cc.base import AccessDecision, CCPlugin
@@ -62,6 +63,7 @@ class Maat(CCPlugin):
     txn_db_merge = {"maat_lower": "max", "maat_upper": "min",
                     "maat_gw": "max", "maat_gr": "max"}
     commit_ts_field = "maat_lower"
+    ship_access_tick = True
 
     def init_db(self, cfg: Config, n_rows: int, B: int, R: int) -> dict:
         return {
@@ -117,62 +119,151 @@ class Maat(CCPlugin):
         tx = jnp.broadcast_to(
             jnp.arange(B, dtype=jnp.int32)[:, None], (B, R)).reshape(-1)
 
-        lo_e = db["maat_lower"][tx]
-        up_e = db["maat_upper"][tx]
-
-        (skey, sts), (s_iw, s_fin, s_tx, s_lo, s_up, s_orig) = seg.sort_by(
-            (key, ts),
-            (iw, fin_e, tx, lo_e, up_e, jnp.arange(n, dtype=jnp.int32)))
+        (skey, sts), (s_iw, s_fin, s_tx) = seg.sort_by(
+            (key, ts), (iw, fin_e, tx))
         starts = seg.segment_starts(skey)
 
-        # same-tick earlier finishers act VALIDATED (cases 2/4/5):
-        fw = s_fin & s_iw     # finisher writes
-        fr = s_fin & ~s_iw    # finisher reads
-        # case 2: I read k -> upper <= (earlier finisher-writer lower) - 1
-        c2 = seg.seg_prefix_min(jnp.where(fw, s_lo - 1, BIG_TS), starts, BIG_TS)
-        # case 4: I write k -> lower >= (earlier finisher-reader upper) + 1
-        c4 = seg.seg_prefix_max(jnp.where(fr, s_up + 1, 0), starts, 0)
-        # case 5: I write k -> lower >= (earlier finisher-writer upper) + 1
-        c5 = seg.seg_prefix_max(jnp.where(fw, s_up + 1, 0), starts, 0)
+        # saturating +-1 (the reference pins at 0 / UINT64_MAX,
+        # maat.cpp:57-62,81-86; int32 wraparound would erase the push)
+        up1 = lambda v: jnp.minimum(v, BIG_TS - 1) + 1
+        dn1 = lambda v: jnp.maximum(v, 1) - 1
 
-        unsort = lambda x, init: jnp.full(n, init, jnp.int32).at[s_orig].set(x)
-        c2_e = unsort(jnp.where(s_fin & ~s_iw, c2, BIG_TS), BIG_TS).reshape(B, R)
-        c45_e = unsort(jnp.where(s_fin & s_iw, jnp.maximum(c4, c5), 0),
-                       0).reshape(B, R)
-
+        # cases 1/3: lower above the greatest committed write/read ts seen
+        # at access time (snapshots).  Independent of same-tick neighbors.
         lower = jnp.maximum(db["maat_lower"], db["maat_gw"] + 1)
         has_write = (txn.is_write & granted).any(axis=1)
         lower = jnp.where(finishing & has_write,
                           jnp.maximum(lower, db["maat_gr"] + 1), lower)
-        lower = jnp.maximum(lower, c45_e.max(axis=1))
-        upper = jnp.minimum(db["maat_upper"], c2_e.min(axis=1))
 
-        ok = finishing & (lower < upper)
+        # Same-tick earlier validators are already COMMITTED AND RELEASED
+        # by the time I validate (validation is serialized and
+        # TimeTable::release runs at commit, txn.cpp:431), so cases 2/4/5
+        # IGNORE them.  What binds me instead is the push they applied as
+        # they committed (validation squeeze + commit-time forward
+        # validation, row_maat.cpp:189-314), with commit_ts = their final
+        # lower:
+        #   committed WRITER of a row I touch  -> my upper <= cts - 1
+        #   committed READER of a row I write  -> my lower >= cts + 1
+        # (same-tick finishers were admitted together, so in ts order the
+        # later finisher accessed each shared row after the earlier one —
+        # the "unseen neighbor" direction of the forward push).  Each
+        # push uses the NEIGHBOR's final lower, which itself depends on
+        # pushes from even-earlier validators -> compute the unique fixed
+        # point of the ts-ordered chain.
+        static_lower = lower
 
-        # neighbor squeeze for successful validators (maat.cpp:121-157 +
-        # row_maat commit-time forward validation, consolidated):
-        ok_e_sorted = ok[s_tx] & s_fin
-        run_e_sorted = (skey != NULL_KEY) & ~s_fin  # live, not finishing
-        lower_f = lower[s_tx]
-        upper_f = upper[s_tx]
-        # per row: min lower over committing writers; max upper over
-        # committing touchers (read or write)
-        min_lo_w = seg.seg_min_where(lower_f, ok_e_sorted & s_iw, starts, BIG_TS)
-        max_up_t = seg.seg_max_where(upper_f, ok_e_sorted, starts, 0)
-        max_up_w = seg.seg_max_where(upper_f, ok_e_sorted & s_iw, starts, 0)
+        # exclude my own entries from the prefix pushes (a txn never pushes
+        # itself; it also keeps the fixed point free of self-oscillation on
+        # duplicate-key txns): same-txn entries are contiguous after the
+        # stable (key, ts) sort, so the prefix value at my (key, txn)-run
+        # start sees exactly the other txns before me
+        idx = jnp.arange(n, dtype=jnp.int32)
+        run_starts = starts | jnp.where(idx == 0, True,
+                                        s_tx != jnp.roll(s_tx, 1))
+        run_start_idx = jax.lax.cummax(jnp.where(run_starts, idx, 0))
 
-        # running readers of a committed-written row: upper <= min_lo_w - 1
-        new_up = jnp.where(run_e_sorted & ~s_iw & (min_lo_w < BIG_TS),
-                           min_lo_w - 1, BIG_TS)
-        # running writers of a row a committer touched: lower >= max_up + 1
-        # (writers of my read rows AND of my write rows form the after set)
-        cap = jnp.where(run_e_sorted & s_iw & (max_up_t > 0),
-                        max_up_t + 1, 0)
+        def caps(okv, lov):
+            okx = okv[s_tx] & s_fin
+            lo_e = lov[s_tx]
+            pmw = seg.seg_prefix_min(
+                jnp.where(okx & s_iw, dn1(lo_e), BIG_TS), starts,
+                BIG_TS)[run_start_idx]
+            plr = seg.seg_prefix_max(
+                jnp.where(okx & ~s_iw, up1(lo_e), 0), starts,
+                0)[run_start_idx]
+            cap_e = jnp.where(s_fin, pmw, BIG_TS)
+            push_e = jnp.where(s_fin & s_iw, plr, 0)
+            upper_new = jnp.minimum(
+                db["maat_upper"],
+                jnp.full(B, BIG_TS, jnp.int32).at[s_tx].min(cap_e))
+            lower_new = jnp.maximum(
+                static_lower,
+                jnp.zeros(B, jnp.int32).at[s_tx].max(push_e))
+            return lower_new, upper_new
 
-        upper_arr = db["maat_upper"].at[s_tx].min(new_up)
-        lower_arr = db["maat_lower"].at[s_tx].max(cap)
+        def step(carry):
+            okv, lov, _ = carry
+            lower_new, upper_new = caps(okv, lov)
+            new_ok = finishing & (lower_new < upper_new)
+            changed = jnp.any(new_ok != okv) | jnp.any(lower_new != lov)
+            return new_ok, lower_new, changed
+
+        ok, lower, _ = jax.lax.while_loop(
+            lambda c: c[2], step,
+            (finishing, static_lower, jnp.any(finishing) | True))
+        lower, upper = caps(ok, lower)
+
+        # --- directional neighbor squeeze: consolidation of the validation
+        # squeeze (maat.cpp:121-170) + commit-time forward validation
+        # (row_maat.cpp:189-314).  The direction a live txn W is pushed
+        # relative to a committer C depends on per-row ACCESS ORDER:
+        #   running writer W vs committing writer C:
+        #     W accessed before C -> C saw W:  W after C (lower >= C.up+1)
+        #     W accessed after C  -> C never saw W: the reference orders W
+        #       BEFORE C (upper <= commit_ts-1, row_maat.cpp:222-233)
+        #   running writer W vs committing reader C: W after C either way
+        #     (upper+1 if C saw W at validation, commit_ts+1 = lower+1 if
+        #      not, row_maat.cpp:249-274)
+        #   running reader R vs committing writer C: R before C either way
+        #     (upper <= C.lower - 1)
+        # Access order is computable without extra state because MaaT
+        # accesses never block: access r granted at start_tick + r//window.
+        atick = (jnp.broadcast_to(txn.start_tick[:, None], (B, R))
+                 + ridx // max(cfg.acquire_window, 1)).reshape(-1)
+        (k2, a2, t2), (w2, f2, x2) = seg.sort_by(
+            (key, atick, ts), (iw, fin_e, tx))
+        st2 = seg.segment_starts(k2)
+        live2 = k2 != NULL_KEY
+        okx = ok[x2]
+        cw = live2 & f2 & w2 & okx          # committing writers
+        cr = live2 & f2 & ~w2 & okx         # committing readers
+        run2 = live2 & ~f2                  # live, not finishing
+        # running entries carry their CURRENT db bounds; committing entries
+        # their final validated bounds
+        lo_cur = jnp.where(finishing, lower, db["maat_lower"])
+        up_cur = jnp.where(finishing, upper, db["maat_upper"])
+        lo2 = lo_cur[x2]
+        up2 = up_cur[x2]
+
+        # validator self-adjustment before the after-push (maat.cpp:145-156):
+        # a committer's upper ducks under the range of a running writer it
+        # SAW (prefix in access order) when possible, weakening that push
+        cand = jnp.where(run2 & w2,
+                         jnp.where(up2 < BIG_TS, up2 - 2,
+                                   jnp.where(lo2 > 1, lo2 - 1, BIG_TS)),
+                         BIG_TS)
+        pre_cand = seg.seg_prefix_min(cand, st2, BIG_TS)
+        adj = jnp.full(B, BIG_TS, jnp.int32).at[x2].min(
+            jnp.where(live2 & f2, pre_cand, BIG_TS))
+        upper_v = jnp.where(ok, jnp.maximum(jnp.minimum(upper, adj),
+                                            lower + 1), upper)
+        up2c = upper_v[x2]
+
+        # committers AFTER me in access order saw my entry (I was in their
+        # uncommitted sets): their validation orders me AFTER them.
+        # Committers BEFORE me never saw me: their commit-push orders me
+        # BEFORE them (writers) / AFTER commit_ts (readers).
+        suf_up_cw = seg.seg_suffix_max(jnp.where(cw, up1(up2c), 0), st2, 0)
+        suf_up_cr = seg.seg_suffix_max(jnp.where(cr, up1(up2c), 0), st2, 0)
+        pre_lo_cr = seg.seg_prefix_max(jnp.where(cr, up1(lo2), 0), st2, 0)
+        pre_lo_cw = seg.seg_prefix_min(jnp.where(cw, dn1(lo2), BIG_TS),
+                                       st2, BIG_TS)
+        all_lo_cw = seg.seg_min_where(dn1(lo2), cw, st2, BIG_TS)
+
+        # running writers: ordered after committers that saw them, before
+        # committing writers that did not
+        w_lo = jnp.maximum(jnp.maximum(suf_up_cw, suf_up_cr), pre_lo_cr)
+        w_up = pre_lo_cw
+        # running readers: before every committing writer of the row
+        r_up = all_lo_cw
+
+        new_lo2 = jnp.where(run2 & w2, w_lo, 0)
+        new_up2 = jnp.where(run2, jnp.where(w2, w_up, r_up), BIG_TS)
+
+        upper_arr = db["maat_upper"].at[x2].min(new_up2)
+        lower_arr = db["maat_lower"].at[x2].max(new_lo2)
         # also persist the validators' own tightened bounds
-        upper_arr = jnp.where(finishing, upper, upper_arr)
+        upper_arr = jnp.where(finishing, upper_v, upper_arr)
         lower_arr = jnp.where(finishing, lower, lower_arr)
 
         return ok, {**db, "maat_lower": lower_arr, "maat_upper": upper_arr}

@@ -23,6 +23,7 @@ per-restart ``start_tick`` provides exactly that.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from deneva_tpu.cc.base import AccessDecision, CCPlugin
@@ -60,9 +61,14 @@ class Occ(CCPlugin):
         hist_conflict = rmask & (db["occ_wcommit"][k] > txn.start_tick[:, None])
         pass1 = finishing & ~hist_conflict.any(axis=1)
 
-        # --- same-tick active-writer check (occ.cpp:185-199): serialize
-        # this tick's finishers by ts; I conflict if an earlier finisher
-        # that passed the history check writes a key I read or write ---
+        # --- same-tick active-writer check (occ.cpp:185-233): serialize
+        # this tick's finishers by ts.  Under the global semaphore a FAILED
+        # validator removes itself from the active set before the next
+        # validator snapshots it (occ.cpp:219-233), so only finishers that
+        # themselves fully validate may block later ones.  That is a
+        # prefix-dependent greedy filter; compute its unique fixed point by
+        # iterating "valid = pass1 & no earlier VALID writer conflicts"
+        # (iteration n settles every conflict chain of depth <= n). ---
         ent_live = (valid_acc & pass1[:, None]).reshape(-1)
         key = jnp.where(ent_live, txn.keys.reshape(-1), NULL_KEY)
         ts = jnp.broadcast_to(txn.ts[:, None], (B, R)).reshape(-1)
@@ -74,12 +80,32 @@ class Occ(CCPlugin):
             (key, ts), (iw, tx, jnp.arange(n, dtype=jnp.int32)))
         starts = seg.segment_starts(skey)
         live = skey != NULL_KEY
-        w_before = seg.seg_any_before(s_iw & live, starts)
-        conflict_sorted = live & w_before
-        conflict = jnp.zeros(n, dtype=bool).at[s_orig].set(conflict_sorted)
-        pass2_fail = conflict.reshape(B, R).any(axis=1)
+        # a txn never conflicts with itself (test_valid intersects OTHER
+        # txns' sets): same-txn duplicate-key entries are contiguous after
+        # the stable (key, ts) sort (ts unique per txn), so reading the
+        # exclusive prefix at my (key, txn)-run start skips exactly them —
+        # it also keeps the fixed point free of self-oscillation
+        idx = jnp.arange(n, dtype=jnp.int32)
+        run_starts = starts | jnp.where(idx == 0, True,
+                                        s_tx != jnp.roll(s_tx, 1))
+        run_start_idx = jax.lax.cummax(jnp.where(run_starts, idx, 0))
 
-        return pass1 & ~pass2_fail, db
+        def step(carry):
+            valid, _ = carry
+            blocking = live & s_iw & valid[s_tx]
+            cnt_before = seg.seg_cumsum_exclusive(
+                blocking.astype(jnp.int32), starts)
+            w_before = cnt_before[run_start_idx] > 0
+            conflict = jnp.zeros(n, dtype=bool).at[s_orig].set(
+                live & w_before)
+            new_valid = pass1 & ~conflict.reshape(B, R).any(axis=1)
+            return new_valid, jnp.any(new_valid != valid)
+
+        # initial changed=True derived from pass1 so its sharding (varying
+        # axes under shard_map) matches the body output
+        valid, _ = jax.lax.while_loop(
+            lambda c: c[1], step, (pass1, jnp.any(pass1) | True))
+        return valid, db
 
     def on_commit(self, cfg: Config, db: dict, txn: TxnState, committed,
                   commit_ts, tick):

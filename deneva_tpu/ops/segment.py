@@ -131,3 +131,24 @@ def seg_prefix_min(vals: jnp.ndarray, starts: jnp.ndarray,
                    identity: int) -> jnp.ndarray:
     """Min over elements strictly before me in my segment (identity if none)."""
     return _seg_scan(vals, starts, jnp.minimum, identity)
+
+
+def _seg_ends(starts: jnp.ndarray) -> jnp.ndarray:
+    """Mask marking the last element of each equal-id run."""
+    return jnp.roll(starts, -1).at[-1].set(True)
+
+
+def seg_suffix_min(vals: jnp.ndarray, starts: jnp.ndarray,
+                   identity: int) -> jnp.ndarray:
+    """Min over elements strictly after me in my segment (identity if none)."""
+    rev = lambda x: x[::-1]
+    return rev(_seg_scan(rev(vals), rev(_seg_ends(starts)),
+                         jnp.minimum, identity))
+
+
+def seg_suffix_max(vals: jnp.ndarray, starts: jnp.ndarray,
+                   identity: int = 0) -> jnp.ndarray:
+    """Max over elements strictly after me in my segment (identity if none)."""
+    rev = lambda x: x[::-1]
+    return rev(_seg_scan(rev(vals), rev(_seg_ends(starts)),
+                         jnp.maximum, identity))

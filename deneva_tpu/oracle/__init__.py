@@ -1,0 +1,5 @@
+"""Sequential reference interpreter + parity harness (SURVEY.md §4)."""
+
+from deneva_tpu.oracle.sequential import SequentialEngine
+
+__all__ = ["SequentialEngine"]

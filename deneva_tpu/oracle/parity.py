@@ -1,0 +1,55 @@
+"""Abort-rate parity harness: batched TPU engine vs sequential oracle.
+
+The north star (BASELINE.json) demands <1% abort-rate divergence from the
+reference.  The C++ binary cannot be built here (vendored deps absent, no
+network), so the comparison target is deneva_tpu.oracle.sequential — the
+reference's decision rules replayed sequentially on the SAME query pool with
+the SAME slot/tick protocol (the metric definition mirrors
+statistics/stats.cpp:431-456: tput numerator txn_cnt, abort_rate =
+aborts / (aborts + commits)).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from deneva_tpu.config import Config
+from deneva_tpu.engine.scheduler import Engine
+from deneva_tpu.oracle.sequential import SequentialEngine
+from deneva_tpu.workloads import ycsb
+
+
+def run_pair(cfg: Config, n_ticks: int) -> dict:
+    """Run both engines on one shared pool; return their stats + divergence."""
+    pool = ycsb.gen_query_pool(cfg)
+
+    eng = Engine(cfg, pool=pool)
+    st = eng.run(n_ticks)
+    b = eng.summary(st)
+    b_data = np.asarray(st.data)
+
+    seq = SequentialEngine(cfg, pool=pool).run(n_ticks)
+    s = seq.summary()
+
+    out = {
+        "cc_alg": cfg.cc_alg,
+        "batched": {k: b[k] for k in
+                    ("txn_cnt", "total_txn_abort_cnt", "abort_rate",
+                     "write_cnt")},
+        "sequential": {k: s[k] for k in
+                       ("txn_cnt", "total_txn_abort_cnt", "abort_rate",
+                        "write_cnt")},
+        "abort_rate_divergence": abs(b["abort_rate"] - s["abort_rate"]),
+        "tput_ratio": b["txn_cnt"] / max(s["txn_cnt"], 1),
+        "batched_conserved": int(b_data.sum()) == b["write_cnt"],
+        "sequential_conserved": int(seq.data.sum()) == s["write_cnt"],
+    }
+    return out
+
+
+def parity_table(algs, cfg_kw: dict, n_ticks: int = 60) -> list[dict]:
+    rows = []
+    for alg in algs:
+        cfg = Config(cc_alg=alg, **cfg_kw)
+        rows.append(run_pair(cfg, n_ticks))
+    return rows

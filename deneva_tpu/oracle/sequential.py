@@ -1,0 +1,607 @@
+"""Sequential reference interpreter — the abort-rate parity oracle.
+
+The C++ reference cannot be built in this environment (its vendored
+boost/nanomsg/jemalloc trees are absent and there is no network), so per
+SURVEY.md §4 the parity baseline is this interpreter: a pure-Python,
+pointer-structure implementation of the reference's per-row CC decision
+rules (row_lock.cpp, row_ts.cpp, row_mvcc.cpp, occ.cpp, maat.cpp,
+row_maat.cpp), driven by the same slot/tick/admission protocol as the
+batched engine so that any commit/abort divergence measures the CC kernels
+— not the driver.
+
+Deliberate structural differences from the batched engine (that is the
+point — shared bugs are impossible):
+
+- locks / requests / versions are Python lists, dicts and sets attached to
+  rows, exactly like the reference's owner lists, request buffers, version
+  chains, and TimeTable — not segment reductions;
+- MVCC keeps an UNBOUNDED version history (the reference recycles only
+  lazily via HIS_RECYCLE_LEN); the batched engine's bounded ring + floor is
+  an approximation whose cost shows up here as divergence;
+- MaaT keeps true per-txn uncommitted_reads/writes/writes_y sets copied at
+  access time (row_maat.cpp:64-95) and the commit-time forward validation
+  (row_maat.cpp:189-314) that the batched engine consolidates into its
+  validation pass.
+
+Within a tick, transactions are processed in timestamp order — the arrival
+order the batched kernels are defined to emulate (cc/twopl.py docstring).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from deneva_tpu.config import Config, YCSB
+from deneva_tpu.workloads.base import QueryPool
+
+BIG = np.int64(2**62)
+
+FREE, RUNNING, WAITING, BACKOFF = 0, 1, 2, 3
+
+
+@dataclasses.dataclass
+class SeqTxn:
+    slot: int
+    tid: int = 0        # unique per admitted query; stable across restarts
+                        # (the reference txn_id: worker_thread.cpp:453-458)
+    status: int = FREE
+    ts: int = 0
+    cursor: int = 0
+    restarts: int = 0
+    backoff_until: int = 0
+    start_tick: int = 0
+    keys: np.ndarray = None
+    is_write: np.ndarray = None
+    n_req: int = 0
+    # MaaT per-txn state (system/txn.h uncommitted_* sets, gr/gw snapshots)
+    maat = None
+
+
+class Manager:
+    """Per-algorithm reference-rule engine (grant/wait/abort + commit)."""
+
+    needs_new_ts_on_restart = False
+
+    def __init__(self, cfg: Config, n_rows: int):
+        self.cfg = cfg
+        self.n_rows = n_rows
+
+    def on_start(self, txn: SeqTxn):
+        pass
+
+    def access(self, txn: SeqTxn, key: int, iw: bool) -> str:
+        raise NotImplementedError
+
+    def validate(self, txn: SeqTxn, tick: int) -> bool:
+        return True
+
+    def commit(self, txn: SeqTxn, tick: int):
+        pass
+
+    def abort(self, txn: SeqTxn):
+        pass
+
+
+class LockManager(Manager):
+    """NO_WAIT / WAIT_DIE (row_lock.cpp:52-217).
+
+    owners[key] = list of (slot, ts, is_write).  WAIT_DIE die rule: wait
+    iff requester ts < every owner's ts (row_lock.cpp:91-151); NO_WAIT:
+    any conflict aborts (row_lock.cpp:86-90)."""
+
+    def __init__(self, cfg, n_rows, policy):
+        super().__init__(cfg, n_rows)
+        self.policy = policy
+        self.owners: dict[int, list] = {}
+
+    def access(self, txn, key, iw):
+        owners = self.owners.setdefault(key, [])
+        mine = [o for o in owners if o[0] == txn.slot]
+        others = [o for o in owners if o[0] != txn.slot]
+        conflict = any(o[2] for o in others) if not iw else bool(others)
+        if mine:  # re-request after WAIT: not a second lock
+            conflict = conflict or False
+        if not conflict:
+            owners.append((txn.slot, txn.ts, iw))
+            return "grant"
+        if self.policy == "NO_WAIT":
+            return "abort"
+        # WAIT_DIE
+        if all(txn.ts < o[1] for o in others):
+            return "wait"
+        return "abort"
+
+    def _release(self, txn):
+        for key in txn.keys[:txn.cursor]:
+            k = int(key)
+            if k in self.owners:
+                self.owners[k] = [o for o in self.owners[k]
+                                  if o[0] != txn.slot]
+
+    def commit(self, txn, tick):
+        self._release(txn)
+
+    def abort(self, txn):
+        self._release(txn)
+
+
+class CalvinManager(Manager):
+    """CALVIN FIFO locks (row_lock.cpp:78-81,152-170): entries queue in
+    sequence order and never abort; a write grants only at the queue head,
+    a read only if no write is queued ahead of it."""
+
+    def __init__(self, cfg, n_rows):
+        super().__init__(cfg, n_rows)
+        self.queues: dict[int, list] = {}   # key -> [(ts, slot, iw)] sorted
+
+    def access(self, txn, key, iw):
+        q = self.queues.setdefault(key, [])
+        if not any(s == txn.slot for (_, s, _) in q):
+            q.append((txn.ts, txn.slot, iw))
+            q.sort()
+        pos = next(i for i, (_, s, _) in enumerate(q) if s == txn.slot)
+        if iw:
+            granted = pos == 0
+        else:
+            granted = not any(w for (_, s, w) in q[:pos])
+        return "grant" if granted else "wait"
+
+    def commit(self, txn, tick):
+        for q in self.queues.values():
+            q[:] = [e for e in q if e[1] != txn.slot]
+
+    def abort(self, txn):  # pragma: no cover - Calvin never aborts
+        raise AssertionError("Calvin aborted")
+
+
+class TimestampManager(Manager):
+    """Basic T/O (row_ts.cpp:167-266): per-row wts/rts + pending prewrites.
+
+    R: ts<wts -> Abort; pending prewrite with pts<ts -> WAIT (min_pts rule);
+       else grant, rts=max(rts,ts).
+    P: ts<rts -> Abort; ts<wts -> Abort (unless TS_TWR); else buffer.
+    Commit applies the write and bumps wts (TWR: stale write skipped)."""
+
+    needs_new_ts_on_restart = True
+
+    def __init__(self, cfg, n_rows):
+        super().__init__(cfg, n_rows)
+        self.wts = {}
+        self.rts = {}
+        self.pending: dict[int, dict] = {}   # key -> {slot: ts}
+
+    def access(self, txn, key, iw):
+        wts = self.wts.get(key, 0)
+        rts = self.rts.get(key, 0)
+        pend = self.pending.setdefault(key, {})
+        if iw:
+            if txn.ts < rts:
+                return "abort"
+            if not self.cfg.ts_twr and txn.ts < wts:
+                return "abort"
+            pend[txn.slot] = txn.ts
+            return "grant"
+        if txn.ts < wts:
+            return "abort"
+        if any(pts < txn.ts for s, pts in pend.items() if s != txn.slot):
+            return "wait"
+        self.rts[key] = max(rts, txn.ts)
+        return "grant"
+
+    def commit(self, txn, tick):
+        for r in range(txn.cursor):
+            if txn.is_write[r]:
+                k = int(txn.keys[r])
+                self.pending.get(k, {}).pop(txn.slot, None)
+                if self.cfg.ts_twr and txn.ts < self.wts.get(k, 0):
+                    continue  # Thomas write rule: stale write dropped
+                self.wts[k] = max(self.wts.get(k, 0), txn.ts)
+
+    def abort(self, txn):
+        for pend in self.pending.values():
+            pend.pop(txn.slot, None)
+
+
+class MvccManager(Manager):
+    """MVCC (row_mvcc.cpp:198-334) with UNBOUNDED version lists.
+
+    versions[key] = [(wts, rts)] sorted by wts; implicit initial version
+    (0, rts0).  R: serve newest wts<=ts; WAIT if a pending prewrite lies in
+    (v.wts, ts).  P: Abort if the predecessor version's rts > ts."""
+
+    needs_new_ts_on_restart = True
+
+    def __init__(self, cfg, n_rows):
+        super().__init__(cfg, n_rows)
+        self.versions: dict[int, list] = {}   # key -> [[wts, rts] sorted]
+        self.pending: dict[int, dict] = {}
+
+    def _pred(self, key, ts):
+        vs = self.versions.get(key, [])
+        best = None
+        for v in vs:
+            if v[0] <= ts and (best is None or v[0] > best[0]):
+                best = v
+        return best
+
+    def access(self, txn, key, iw):
+        pend = self.pending.setdefault(key, {})
+        v = self._pred(key, txn.ts)
+        v_wts = v[0] if v else 0
+        if iw:
+            v_rts = v[1] if v else self._rts0(key)
+            if v_rts > txn.ts:
+                return "abort"
+            pend[txn.slot] = txn.ts
+            return "grant"
+        if any(v_wts < pts < txn.ts
+               for s, pts in pend.items() if s != txn.slot):
+            return "wait"
+        if v:
+            v[1] = max(v[1], txn.ts)
+        else:
+            self._set_rts0(key, txn.ts)
+        return "grant"
+
+    def _rts0(self, key):
+        return self.versions.setdefault(key, [[0, 0]])[0][1]
+
+    def _set_rts0(self, key, ts):
+        vs = self.versions.setdefault(key, [[0, 0]])
+        vs[0][1] = max(vs[0][1], ts)
+
+    def commit(self, txn, tick):
+        for r in range(txn.cursor):
+            if txn.is_write[r]:
+                k = int(txn.keys[r])
+                self.pending.get(k, {}).pop(txn.slot, None)
+                self.versions.setdefault(k, [[0, 0]]).append([txn.ts, 0])
+
+    def abort(self, txn):
+        for pend in self.pending.values():
+            pend.pop(txn.slot, None)
+
+
+class OccManager(Manager):
+    """OCC backward validation (occ.cpp:116-294): history check on the read
+    set vs writes committed after my (re)start, plus serialized same-tick
+    finisher check against earlier validators' write sets."""
+
+    needs_new_ts_on_restart = True
+
+    def __init__(self, cfg, n_rows):
+        super().__init__(cfg, n_rows)
+        self.wlast: dict[int, int] = {}    # key -> last committed-write tick
+        self._tick_wsets: list = []        # same-tick validators' write sets
+        self._tick = -1
+
+    def access(self, txn, key, iw):
+        return "grant"                     # optimistic work phase
+
+    def validate(self, txn, tick):
+        if tick != self._tick:
+            self._tick, self._tick_wsets = tick, []
+        rset = {int(txn.keys[r]) for r in range(txn.n_req)
+                if not txn.is_write[r]}
+        wset = {int(txn.keys[r]) for r in range(txn.n_req)
+                if txn.is_write[r]}
+        # history check (occ.cpp:167-180): reads vs later committed writes
+        if any(self.wlast.get(k, -1) > txn.start_tick for k in rset):
+            return False
+        # active-writer check (occ.cpp:185-199): earlier same-tick
+        # validators' write sets vs my read AND write sets
+        for w in self._tick_wsets:
+            if w & (rset | wset):
+                return False
+        self._tick_wsets.append(wset)
+        return True
+
+    def commit(self, txn, tick):
+        for r in range(txn.n_req):
+            if txn.is_write[r]:
+                self.wlast[int(txn.keys[r])] = tick
+
+
+@dataclasses.dataclass
+class MaatTxn:
+    lower: int = 0
+    upper: int = int(BIG)
+    state: str = "RUNNING"     # RUNNING/VALIDATED/COMMITTED/ABORTED
+    gr: int = 0
+    gw: int = 0
+    uw: set = dataclasses.field(default_factory=set)    # writers of my reads
+    ur: set = dataclasses.field(default_factory=set)    # readers of my writes
+    uwy: set = dataclasses.field(default_factory=set)   # writers of my writes
+
+
+class MaatManager(Manager):
+    """MaaT (maat.cpp:29-190, row_maat.cpp:54-314), full reference
+    structures: TimeTable ranges, per-row lr/lw + uncommitted sets, access-
+    time set copies, the 5 validation cases, neighbor squeeze, and
+    commit-time forward validation."""
+
+    needs_new_ts_on_restart = True
+
+    def __init__(self, cfg, n_rows):
+        super().__init__(cfg, n_rows)
+        self.tt: dict[int, MaatTxn] = {}    # tid -> record (TimeTable; released at commit)
+        self.lr: dict[int, int] = {}
+        self.lw: dict[int, int] = {}
+        self.u_reads: dict[int, set] = {}
+        self.u_writes: dict[int, set] = {}
+
+    def on_start(self, txn):
+        # time_table.init on RTXN (worker_thread.cpp:504-508): restarts
+        # re-init the SAME id; new queries get a fresh id
+        self.tt[txn.tid] = MaatTxn()
+
+    def access(self, txn, key, iw):
+        m = self.tt[txn.tid]
+        ur = self.u_reads.setdefault(key, set())
+        uw = self.u_writes.setdefault(key, set())
+        if iw:  # prewrite (row_maat.cpp:129-164)
+            m.ur |= {s for s in ur if s != txn.tid}
+            m.uwy |= {s for s in uw if s != txn.tid}
+            m.gr = max(m.gr, self.lr.get(key, 0))
+            m.gw = max(m.gw, self.lw.get(key, 0))
+            uw.add(txn.tid)
+        else:   # read (row_maat.cpp:99-127)
+            m.uw |= {s for s in uw if s != txn.tid}
+            m.gw = max(m.gw, self.lw.get(key, 0))
+            ur.add(txn.tid)
+        return "grant"
+
+    def validate(self, txn, tick):
+        # maat.cpp:29-174 verbatim case structure
+        m = self.tt[txn.tid]
+        lower, upper = m.lower, m.upper
+        after, before = set(), set()
+        if lower <= m.gw:                                   # case 1
+            lower = m.gw + 1
+        for s in m.uw:                                      # case 2
+            o = self.tt.get(s)
+            if o is None:
+                continue
+            if upper >= o.lower:
+                if o.state in ("VALIDATED", "COMMITTED"):
+                    upper = o.lower - 1 if o.lower > 0 else o.lower
+                elif o.state == "RUNNING":
+                    after.add(s)
+        if lower <= m.gr:                                   # case 3
+            lower = m.gr + 1
+        for s in m.ur:                                      # case 4
+            o = self.tt.get(s)
+            if o is None:
+                continue
+            if lower <= o.upper:
+                if o.state in ("VALIDATED", "COMMITTED"):
+                    lower = o.upper + 1 if o.upper < BIG else o.upper
+                elif o.state == "RUNNING":
+                    before.add(s)
+        for s in m.uwy:                                     # case 5
+            o = self.tt.get(s)
+            if o is None or o.state == "ABORTED":
+                continue
+            if o.state in ("VALIDATED", "COMMITTED"):
+                if lower <= o.upper:
+                    lower = o.upper + 1 if o.upper < BIG else o.upper
+            elif o.state == "RUNNING":
+                after.add(s)
+        if lower >= upper:
+            m.state = "ABORTED"
+            m.lower, m.upper = lower, upper
+            return False
+        m.state = "VALIDATED"
+        # neighbor squeeze (maat.cpp:121-157)
+        for s in before:
+            o = self.tt[s]
+            if o.upper > lower and o.upper < upper - 1:
+                lower = o.upper + 1
+        for s in before:
+            o = self.tt[s]
+            if o.upper >= lower:
+                o.upper = lower - 1 if lower > 0 else lower
+        for s in after:
+            o = self.tt[s]
+            if o.upper != BIG and o.upper > lower + 2 and o.upper < upper:
+                upper = o.upper - 2
+            if lower + 1 < o.lower < upper:
+                upper = o.lower - 1
+        for s in after:
+            o = self.tt[s]
+            if o.lower <= upper:
+                o.lower = upper + 1 if upper < BIG else upper
+        assert lower < upper
+        m.lower, m.upper = lower, upper
+        return True
+
+    def commit(self, txn, tick):
+        m = self.tt[txn.tid]
+        m.state = "COMMITTED"
+        cts = m.lower                       # find_bound (maat.cpp:176-190)
+        for r in range(txn.n_req):
+            k = int(txn.keys[r])
+            if txn.is_write[r]:
+                # Row_maat::commit WR (row_maat.cpp:277-307)
+                self.lw[k] = max(self.lw.get(k, 0), cts)
+                self.u_writes.get(k, set()).discard(txn.tid)
+                for s in self.u_writes.get(k, set()):
+                    if s not in m.uwy:      # writers I never saw: before me
+                        o = self.tt.get(s)
+                        if o and o.upper >= cts:
+                            o.upper = cts - 1
+                for s in self.u_reads.get(k, set()):
+                    if s not in m.ur:       # readers I never saw: before me
+                        o = self.tt.get(s)
+                        if o and o.upper >= m.lower:
+                            o.upper = m.lower - 1
+            else:
+                # Row_maat::commit RD (row_maat.cpp:249-274)
+                self.lr[k] = max(self.lr.get(k, 0), cts)
+                self.u_reads.get(k, set()).discard(txn.tid)
+                for s in self.u_writes.get(k, set()):
+                    if s not in m.uw:       # writers I never saw: after me
+                        o = self.tt.get(s)
+                        if o and o.lower <= cts:
+                            o.lower = cts + 1
+        # TimeTable::release (txn.cpp:431): stale lookups read defaults
+        # (state ABORTED) and are ignored by later validators
+        del self.tt[txn.tid]
+
+    def abort(self, txn):
+        # validate set ABORTED; txn.cpp:463 releases the entry at abort too
+        # (a restart re-inits the same id via on_start)
+        self.tt.pop(txn.tid, None)
+        for k in range(txn.n_req):
+            key = int(txn.keys[k])
+            self.u_reads.get(key, set()).discard(txn.tid)
+            self.u_writes.get(key, set()).discard(txn.tid)
+
+
+def make_manager(cfg: Config, n_rows: int) -> Manager:
+    alg = cfg.cc_alg
+    if alg in ("NO_WAIT", "WAIT_DIE"):
+        return LockManager(cfg, n_rows, alg)
+    if alg == "CALVIN":
+        return CalvinManager(cfg, n_rows)
+    if alg == "TIMESTAMP":
+        return TimestampManager(cfg, n_rows)
+    if alg == "MVCC":
+        return MvccManager(cfg, n_rows)
+    if alg == "OCC":
+        return OccManager(cfg, n_rows)
+    if alg == "MAAT":
+        return MaatManager(cfg, n_rows)
+    raise KeyError(alg)
+
+
+class SequentialEngine:
+    """Drives the same slot/tick protocol as engine/scheduler.py, with the
+    reference-rule Manager deciding each access sequentially in ts order."""
+
+    def __init__(self, cfg: Config, pool: QueryPool | None = None):
+        self.cfg = cfg
+        if pool is None:
+            assert cfg.workload == YCSB
+            from deneva_tpu.workloads import ycsb
+            pool = ycsb.gen_query_pool(cfg)
+        self.pool = pool
+        self.man = make_manager(cfg, cfg.synth_table_size)
+        B = cfg.batch_size
+        self.txns = [SeqTxn(slot=i) for i in range(B)]
+        self.data = np.zeros(cfg.synth_table_size, np.int64)
+        self.tick = 0
+        self.pool_cursor = 0
+        self.ts_counter = 1
+        self.next_tid = 1
+        self.stats = dict(txn_cnt=0, total_txn_abort_cnt=0,
+                          unique_txn_abort_cnt=0, write_cnt=0,
+                          local_txn_start_cnt=0)
+
+    # -- driver protocol mirrors engine/scheduler.py's tick phases --
+
+    def run(self, n_ticks: int):
+        for _ in range(n_ticks):
+            self._tick()
+        return self
+
+    def _tick(self):
+        cfg, man, t = self.cfg, self.man, self.tick
+        redraw = man.needs_new_ts_on_restart or cfg.restart_new_ts
+
+        # 1. backoff expiry (slot order, like the batched cumsum ranks)
+        for txn in self.txns:
+            if txn.status == BACKOFF and txn.backoff_until <= t:
+                txn.status = RUNNING
+                txn.start_tick = t
+                if redraw:
+                    txn.ts = self.ts_counter
+                    self.ts_counter += 1
+                man.on_start(txn)
+
+        # 2. admission (slot order; epoch cap for Calvin)
+        plugin_epoch = cfg.cc_alg == "CALVIN"
+        admitted = 0
+        for txn in self.txns:
+            if txn.status != FREE:
+                continue
+            if plugin_epoch and admitted >= cfg.epoch_size:
+                break
+            q = self.pool_cursor % self.pool.size
+            txn.keys = self.pool.keys[q]
+            txn.is_write = self.pool.is_write[q]
+            txn.n_req = int(self.pool.n_req[q])
+            txn.tid = self.next_tid
+            self.next_tid += 1
+            txn.cursor = 0
+            txn.restarts = 0
+            txn.status = RUNNING
+            txn.start_tick = t
+            txn.ts = self.ts_counter
+            self.ts_counter += 1
+            self.pool_cursor += 1
+            admitted += 1
+            self.stats["local_txn_start_cnt"] += 1
+            man.on_start(txn)
+
+        # 3. commit phase (ts order; validation serialized like the batch)
+        finishing = [x for x in self.txns
+                     if x.status == RUNNING and x.cursor >= x.n_req]
+        val_aborted = set()
+        for txn in sorted(finishing, key=lambda x: x.ts):
+            if man.validate(txn, t):
+                man.commit(txn, t)
+                for r in range(txn.n_req):
+                    if txn.is_write[r]:
+                        self.data[int(txn.keys[r])] += 1
+                        self.stats["write_cnt"] += 1
+                self.stats["txn_cnt"] += 1
+                if txn.restarts > 0:
+                    self.stats["unique_txn_abort_cnt"] += 1
+                txn.status = FREE
+            else:
+                val_aborted.add(txn.slot)
+                self._abort(txn)
+
+        # 4. access phase (ts order, window accesses per txn)
+        active = [x for x in self.txns
+                  if x.status in (RUNNING, WAITING)
+                  and x.slot not in val_aborted and x.cursor < x.n_req]
+        window = (self.pool.max_req if cfg.cc_alg == "CALVIN"
+                  else cfg.acquire_window)
+        for txn in sorted(active, key=lambda x: x.ts):
+            for _ in range(min(window, txn.n_req - txn.cursor)):
+                dec = man.access(txn, int(txn.keys[txn.cursor]),
+                                 bool(txn.is_write[txn.cursor]))
+                if dec == "grant":
+                    txn.cursor += 1
+                    txn.status = RUNNING
+                elif dec == "wait":
+                    txn.status = WAITING
+                    break
+                else:
+                    self._abort(txn)
+                    break
+
+        self.tick += 1
+
+    def _abort(self, txn):
+        self.man.abort(txn)
+        self.stats["total_txn_abort_cnt"] += 1
+        shift = min(txn.restarts, 16)
+        penalty = (min(self.cfg.abort_penalty_ticks * (1 << shift),
+                       self.cfg.abort_penalty_max_ticks)
+                   if self.cfg.backoff else self.cfg.abort_penalty_ticks)
+        txn.status = BACKOFF
+        txn.cursor = 0
+        txn.backoff_until = self.tick + penalty
+        txn.restarts += 1
+
+    def summary(self) -> dict:
+        s = dict(self.stats)
+        commits = max(s["txn_cnt"], 1)
+        s["abort_rate"] = s["total_txn_abort_cnt"] / (
+            s["total_txn_abort_cnt"] + commits)
+        return s

@@ -159,12 +159,16 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
         dest = jnp.where(live_e, key_g % n_nodes, n_nodes)
         key_l = key_g // n_nodes
         ts_e = ent.ts
+        stick = jnp.broadcast_to(txn.start_tick[:, None], (B, R))
+        if plugin.ship_access_tick:
+            # per-entry access tick so the owner-side directional squeeze
+            # (cc/maat.py) sees true access order on single-access vtxns
+            stick = stick + ridx // max(cfg.acquire_window, 1)
         fields = {
             "key": jnp.where(live_e, key_l, NULL_KEY),
             "ts": ts_e,
             "flags": _flags(ent.is_write, held, req, fin2.reshape(-1)),
-            "start_tick": jnp.broadcast_to(
-                txn.start_tick[:, None], (B, R)).reshape(-1),
+            "start_tick": stick.reshape(-1),
         }
         for f in plugin.txn_db_fields:
             fields[f] = jnp.broadcast_to(db[f][:, None], (B, R)).reshape(-1)
@@ -430,8 +434,15 @@ class ShardedEngine:
             # invisible to the row owner — another writer could grant and
             # break the deterministic FIFO schedule.  Size the exchange for
             # the worst case (all of a node's B*R entries to one dest) so
-            # overflow is structurally impossible.
+            # overflow is structurally impossible.  Owner-side arbitration
+            # then sees N*B*R virtual entries, which must fit the packed
+            # sort-index width (cc/twopl.py); scale past this bound needs a
+            # hierarchical exchange, not a bigger buffer.
             self.cap = B * R
+            assert N * B * R <= 1 << 23, (
+                f"CALVIN worst-case exchange {N}x{B}x{R} exceeds the "
+                "2^23-entry arbitration bound; lower batch_size or shard "
+                "the epoch")
 
         self._tick_inner = None  # built lazily per pool shard inside spmd
 

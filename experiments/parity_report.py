@@ -1,0 +1,83 @@
+"""Generate PARITY.md: abort-rate parity of the batched TPU engine vs the
+sequential reference interpreter across the BASELINE.json config cells.
+
+Usage: python experiments/parity_report.py [--quick]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from deneva_tpu.config import Config                      # noqa: E402
+from deneva_tpu.oracle.parity import run_pair             # noqa: E402
+
+ALGS = ["NO_WAIT", "WAIT_DIE", "TIMESTAMP", "MVCC", "OCC", "MAAT", "CALVIN"]
+
+CELLS = [
+    # (label, cfg_kw)  — the BASELINE.json five config families, scaled to
+    # interpreter-feasible sizes (the oracle is pure Python)
+    ("uniform read-only", dict(zipf_theta=0.0, txn_read_perc=1.0)),
+    ("zipf 0.6, 50/50 rw", dict(zipf_theta=0.6)),
+    ("zipf 0.9, 50/50 rw", dict(zipf_theta=0.9)),
+]
+
+BASE = dict(batch_size=256, synth_table_size=1 << 16, req_per_query=10,
+            query_pool_size=1 << 12, tup_read_perc=0.5, warmup_ticks=0)
+
+
+def main():
+    quick = "--quick" in sys.argv
+    n_ticks = 30 if quick else 60
+    lines = [
+        "# PARITY — batched TPU engine vs sequential reference interpreter",
+        "",
+        "The C++ reference cannot be built here (vendored boost/nanomsg/"
+        "jemalloc absent, no network), so the baseline is "
+        "`deneva_tpu/oracle/sequential.py`: the reference's per-row decision "
+        "rules (row_lock/row_ts/row_mvcc/occ/maat .cpp) replayed "
+        "sequentially on the SAME query pool under the same slot/tick "
+        "protocol.  Metric definitions follow statistics/stats.cpp:431-456 "
+        "(abort_rate = aborts / (aborts + commits)).",
+        "",
+        f"Config: B={BASE['batch_size']}, table={BASE['synth_table_size']}, "
+        f"R={BASE['req_per_query']}, {n_ticks} ticks, acquire_window=1.",
+        "",
+    ]
+    for label, kw in CELLS:
+        lines += [f"## {label}", "",
+                  "| CC_ALG | batched abort rate | sequential abort rate | "
+                  "divergence | tput ratio | conserved |",
+                  "|---|---|---|---|---|---|"]
+        for alg in ALGS:
+            cfg = Config(cc_alg=alg, **{**BASE, **kw})
+            r = run_pair(cfg, n_ticks)
+            lines.append(
+                f"| {alg} | {r['batched']['abort_rate']:.4f} "
+                f"| {r['sequential']['abort_rate']:.4f} "
+                f"| {r['abort_rate_divergence']:.4f} "
+                f"| {r['tput_ratio']:.3f} "
+                f"| {'yes' if r['batched_conserved'] and r['sequential_conserved'] else 'NO'} |")
+            print(label, alg, f"div={r['abort_rate_divergence']:.4f}")
+        lines.append("")
+    lines += [
+        "Enforced continuously by `tests/test_parity.py` (thresholds with "
+        "~1.5x noise headroom).  Remaining known divergence sources: "
+        "tick-granular wait retries vs in-place waiter promotion (2PL), "
+        "MVCC's bounded version ring vs unbounded lists, MaaT's live-set "
+        "join approximating access-time set snapshots.",
+        "",
+    ]
+    with open("PARITY.md", "w") as f:
+        f.write("\n".join(lines))
+    print("wrote PARITY.md")
+
+
+if __name__ == "__main__":
+    main()

@@ -49,3 +49,17 @@ def test_sort_by_lexicographic():
     np.testing.assert_array_equal(np.asarray(s1), [1, 1, 2, 2])
     np.testing.assert_array_equal(np.asarray(s2), [7, 8, 3, 9])
     np.testing.assert_array_equal(np.asarray(sp), [3, 1, 2, 0])
+
+
+def test_seg_suffix_min_max():
+    import numpy as np
+    import jax.numpy as jnp
+    from deneva_tpu.ops import segment as seg
+    ids = jnp.asarray(np.array([0, 0, 0, 1, 1, 2], np.int32))
+    vals = jnp.asarray(np.array([5, 2, 9, 7, 1, 4], np.int32))
+    starts = seg.segment_starts(ids)
+    sm = seg.seg_suffix_min(vals, starts, 99)
+    sx = seg.seg_suffix_max(vals, starts, 0)
+    # strictly-after reductions within each id run
+    assert sm.tolist() == [2, 9, 99, 1, 99, 99]
+    assert sx.tolist() == [9, 9, 0, 1, 0, 0]
