@@ -153,14 +153,9 @@ class Maat(CCPlugin):
         static_lower = lower
 
         # exclude my own entries from the prefix pushes (a txn never pushes
-        # itself; it also keeps the fixed point free of self-oscillation on
-        # duplicate-key txns): same-txn entries are contiguous after the
-        # stable (key, ts) sort, so the prefix value at my (key, txn)-run
-        # start sees exactly the other txns before me
-        idx = jnp.arange(n, dtype=jnp.int32)
-        run_starts = starts | jnp.where(idx == 0, True,
-                                        s_tx != jnp.roll(s_tx, 1))
-        run_start_idx = jax.lax.cummax(jnp.where(run_starts, idx, 0))
+        # itself; also keeps the fixed point free of self-oscillation on
+        # duplicate-key txns)
+        run_start_idx = seg.run_start_indices(starts, s_tx)
 
         def caps(okv, lov):
             okx = okv[s_tx] & s_fin

@@ -81,14 +81,9 @@ class Occ(CCPlugin):
         starts = seg.segment_starts(skey)
         live = skey != NULL_KEY
         # a txn never conflicts with itself (test_valid intersects OTHER
-        # txns' sets): same-txn duplicate-key entries are contiguous after
-        # the stable (key, ts) sort (ts unique per txn), so reading the
-        # exclusive prefix at my (key, txn)-run start skips exactly them —
-        # it also keeps the fixed point free of self-oscillation
-        idx = jnp.arange(n, dtype=jnp.int32)
-        run_starts = starts | jnp.where(idx == 0, True,
-                                        s_tx != jnp.roll(s_tx, 1))
-        run_start_idx = jax.lax.cummax(jnp.where(run_starts, idx, 0))
+        # txns' sets); reading prefixes at the (key, txn)-run start also
+        # keeps the fixed point free of self-oscillation
+        run_start_idx = seg.run_start_indices(starts, s_tx)
 
         def step(carry):
             valid, _ = carry

@@ -83,7 +83,7 @@ class Config:
     txn_read_perc: float = 0.0        # TXN_READ_PERC (whole-txn read-only prob)
     zipf_theta: float = 0.6           # ZIPF_THETA
     part_per_txn: int = 1             # PART_PER_TXN
-    mpr: float = 0.0                  # MPR: multi-partition txn rate
+    mpr: float = 1.0                  # MPR: multi-partition txn rate (config.h:197)
     first_part_local: bool = True     # FIRST_PART_LOCAL
     strict_ppt: bool = False          # STRICT_PPT
     key_order: bool = False           # KEY_ORDER: sort requests by key

@@ -98,11 +98,8 @@ class LockManager(Manager):
 
     def access(self, txn, key, iw):
         owners = self.owners.setdefault(key, [])
-        mine = [o for o in owners if o[0] == txn.slot]
         others = [o for o in owners if o[0] != txn.slot]
         conflict = any(o[2] for o in others) if not iw else bool(others)
-        if mine:  # re-request after WAIT: not a second lock
-            conflict = conflict or False
         if not conflict:
             owners.append((txn.slot, txn.ts, iw))
             return "grant"
@@ -149,8 +146,11 @@ class CalvinManager(Manager):
         return "grant" if granted else "wait"
 
     def commit(self, txn, tick):
-        for q in self.queues.values():
-            q[:] = [e for e in q if e[1] != txn.slot]
+        # a txn only ever enqueues on its own keys
+        for r in range(txn.n_req):
+            q = self.queues.get(int(txn.keys[r]))
+            if q is not None:
+                q[:] = [e for e in q if e[1] != txn.slot]
 
     def abort(self, txn):  # pragma: no cover - Calvin never aborts
         raise AssertionError("Calvin aborted")
@@ -569,10 +569,23 @@ class SequentialEngine:
         active = [x for x in self.txns
                   if x.status in (RUNNING, WAITING)
                   and x.slot not in val_aborted and x.cursor < x.n_req]
-        window = (self.pool.max_req if cfg.cc_alg == "CALVIN"
-                  else cfg.acquire_window)
         for txn in sorted(active, key=lambda x: x.ts):
-            for _ in range(min(window, txn.n_req - txn.cursor)):
+            if cfg.cc_alg == "CALVIN":
+                # acquire_locks() requests EVERY remaining lock at the
+                # txn's sequencing turn, continuing past WAITs
+                # (ycsb_txn.cpp:49-88); execution needs the full prefix
+                advancing = True
+                for r in range(txn.cursor, txn.n_req):
+                    dec = man.access(txn, int(txn.keys[r]),
+                                     bool(txn.is_write[r]))
+                    if advancing and dec == "grant":
+                        txn.cursor += 1
+                        txn.status = RUNNING
+                    elif advancing:
+                        advancing = False
+                        txn.status = WAITING
+                continue
+            for _ in range(min(cfg.acquire_window, txn.n_req - txn.cursor)):
                 dec = man.access(txn, int(txn.keys[txn.cursor]),
                                  bool(txn.is_write[txn.cursor]))
                 if dec == "grant":

@@ -68,8 +68,12 @@ def gen_query_pool(cfg: Config, seed: int | None = None) -> QueryPool:
     is_read = (r_twr < cfg.txn_read_perc) | (r_tup < cfg.tup_read_perc)
     is_write = ~is_read
 
-    # --- partition choice (ycsb_query.cpp:303-330) ---
+    # --- partition choice (ycsb_query.cpp:303-330) with MPR gating
+    # (ycsb_query.cpp:213-217): with probability mpr a txn may span
+    # multiple partitions; otherwise every request stays in the home
+    # partition (part_limit = 1) ---
     part = rng.integers(0, P, size=(Q, R))
+    multi = rng.integers(0, 10_000, size=Q) / 10_000.0 < cfg.mpr
     if cfg.first_part_local:
         part[:, 0] = home_part
     if cfg.strict_ppt and cfg.part_per_txn <= P:
@@ -87,6 +91,9 @@ def gen_query_pool(cfg: Config, seed: int | None = None) -> QueryPool:
         part = np.take_along_axis(palette, sel, axis=1)
         if cfg.first_part_local:
             part[:, 0] = home_part
+    # MPR gate last so it binds under strict_ppt too: a non-multi txn is
+    # single-partition regardless of the palette (part_limit = 1)
+    part = np.where(multi[:, None], part, home_part[:, None])
 
     # --- zipf row ids, resampling duplicates within a txn ---
     row_id = sampler.sample(rng, (Q, R))

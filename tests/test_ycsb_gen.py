@@ -70,3 +70,31 @@ def test_write_fraction():
     cfg2 = cfg.replace(txn_read_perc=1.0)
     pool2 = ycsb.gen_query_pool(cfg2)
     assert not pool2.is_write.any()
+
+
+def test_mpr_gates_multi_partition_rate():
+    # mpr=0 -> every request stays in the home partition; mpr=1 -> non-first
+    # requests choose partitions uniformly; mpr=0.5 -> about half the txns
+    # are single-partition (ycsb_query.cpp:213-217).
+    from deneva_tpu.config import Config
+    from deneva_tpu.workloads.ycsb import gen_query_pool
+
+    base = dict(node_cnt=4, part_cnt=4, synth_table_size=1 << 12,
+                req_per_query=4, query_pool_size=4096, zipf_theta=0.0)
+    for mpr, lo, hi in [(0.0, 0.0, 0.0), (0.5, 0.40, 0.60), (1.0, 0.95, 1.0)]:
+        pool = gen_query_pool(Config(mpr=mpr, **base))
+        parts = pool.keys % 4
+        multi = (parts != parts[:, :1]).any(axis=1)
+        frac = multi.mean()
+        # at mpr=1 a txn can still be single-partition by chance (~(1/4)^3
+        # of txns), hence hi < 1 tolerance handled via lo bound
+        assert lo <= frac <= hi + 1e-9, (mpr, frac)
+
+
+def test_mpr_zero_single_partition_keys():
+    from deneva_tpu.config import Config
+    from deneva_tpu.workloads.ycsb import gen_query_pool
+    pool = gen_query_pool(Config(node_cnt=2, part_cnt=2,
+                                 synth_table_size=1 << 10, req_per_query=3,
+                                 query_pool_size=512, mpr=0.0))
+    assert ((pool.keys % 2) == pool.home_part[:, None]).all()
