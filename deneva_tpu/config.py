@@ -95,7 +95,14 @@ class Config:
     dist_per_wh: int = 10
     cust_per_dist: int = 2000         # CUST_PER_DIST (100k in full scale)
     max_items: int = 1024             # MAXIMUM ITEMS (100k full scale)
-    tpcc_by_last_name_perc: float = 0.0  # secondary-index path (off: by id)
+    max_items_per_txn: int = 15       # MAX_ITEMS_PER_TXN: NewOrder lines
+    tpcc_by_last_name_perc: float = 0.6  # payment customer lookup mix
+                                      # (y <= 60 rule, tpcc_query.cpp:187)
+    tpcc_rbk_perc: float = 0.0        # NewOrder forced-rollback rate (the
+                                      # reference ships with rbk disabled,
+                                      # tpcc_query.cpp:216-217)
+    tpcc_max_orders: int = 1 << 12    # ORDER/ORDERLINE ring depth per district
+    tpcc_hist_cap: int = 1 << 14      # HISTORY insert ring per shard
 
     # --- PPS (reference config.h:235-242) ---
     max_parts_per: int = 10

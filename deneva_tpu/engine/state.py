@@ -41,6 +41,9 @@ class TxnState(NamedTuple):
     keys: jnp.ndarray          # (B, R) int32
     is_write: jnp.ndarray      # (B, R) bool
     n_req: jnp.ndarray         # (B,) int32
+    txn_type: jnp.ndarray      # (B,) int32: workload program id
+    targs: jnp.ndarray         # (B, A) int32: workload scalar args
+    aux: jnp.ndarray           # (B, R) int32: per-access payload
 
     @property
     def B(self) -> int:
@@ -51,7 +54,7 @@ class TxnState(NamedTuple):
         return self.keys.shape[1]
 
     @staticmethod
-    def empty(B: int, R: int) -> "TxnState":
+    def empty(B: int, R: int, A: int = 1) -> "TxnState":
         # distinct buffers per field: the tick donates its argument, and XLA
         # rejects donating one buffer twice
         zi = lambda: jnp.zeros(B, dtype=jnp.int32)
@@ -61,6 +64,9 @@ class TxnState(NamedTuple):
             keys=jnp.full((B, R), NULL_KEY, dtype=jnp.int32),
             is_write=jnp.zeros((B, R), dtype=bool),
             n_req=zi(),
+            txn_type=zi(),
+            targs=jnp.zeros((B, A), dtype=jnp.int32),
+            aux=jnp.zeros((B, R), dtype=jnp.int32),
         )
 
 

@@ -1,0 +1,5 @@
+"""Multi-table storage: catalog-lite + warehouse-striped key encoding."""
+
+from deneva_tpu.storage.catalog import Catalog, Table
+
+__all__ = ["Catalog", "Table"]

@@ -25,6 +25,7 @@ class QueryPool:
     home_part (Q,)   int32  — partition of the client/home node
     txn_type  (Q,)   int32  — workload-specific program id (0 for YCSB)
     args      (Q, A) int32  — workload-specific scalar args (TPC-C amounts etc.)
+    aux       (Q, R) int32  — per-access payload (TPC-C ol_quantity), 0-filled
     """
 
     keys: np.ndarray
@@ -33,6 +34,11 @@ class QueryPool:
     home_part: np.ndarray
     txn_type: np.ndarray
     args: np.ndarray
+    aux: np.ndarray = None
+
+    def __post_init__(self):
+        if self.aux is None:
+            self.aux = np.zeros_like(self.keys)
 
     @property
     def size(self) -> int:
@@ -41,3 +47,40 @@ class QueryPool:
     @property
     def max_req(self) -> int:
         return self.keys.shape[1]
+
+
+class WorkloadPlugin:
+    """Workload boundary: query generation + commit-time data effects.
+
+    The CC engine is workload-agnostic — a txn is its (keys, is_write)
+    access footprint plus scalar args.  What distinguishes workloads is how
+    queries are generated and what a commit DOES to table data (the
+    reference's per-workload TxnManager compute steps + insert_row calls,
+    e.g. benchmarks/tpcc_txn.cpp:500-900).  Effects are applied as one
+    vectorized pass over the committing batch.
+    """
+
+    name = "?"
+
+    def gen_pool(self, cfg) -> QueryPool:
+        raise NotImplementedError
+
+    def cc_rows(self, cfg) -> int:
+        """Global CC-addressable row-space size (engine data array)."""
+        raise NotImplementedError
+
+    def init_tables(self, cfg, part: int, n_parts: int) -> dict:
+        """Per-shard device table columns ({} if none beyond the oracle)."""
+        return {}
+
+    def apply_commit(self, cfg, tables: dict, txn, commit, tick) -> dict:
+        """Apply committing txns' data effects; pure, jit-traceable."""
+        return tables
+
+    def user_abort(self, cfg, txn, finishing):
+        """Mask of finishing txns that roll back by workload logic even if
+        CC validation passed (TPC-C rbk, tpcc_txn.cpp:485-489).  These
+        release CC state like a commit but apply no effects and are not
+        retried."""
+        import jax.numpy as jnp
+        return jnp.zeros_like(finishing)
