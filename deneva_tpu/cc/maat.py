@@ -183,6 +183,13 @@ class Maat(CCPlugin):
             changed = jnp.any(new_ok != okv) | jnp.any(lower_new != lov)
             return new_ok, lower_new, changed
 
+        # the initial `changed` carry must be constant True (enter the loop
+        # at least once) but ALSO must match the body output's
+        # varying-over-mesh type under shard_map: the body's `changed`
+        # depends on `finishing`, so a bare replicated True fails
+        # while_loop's carry type check on the sharded path.  The
+        # `| True` makes the value constant while `jnp.any(finishing)`
+        # supplies the type.
         ok, lower, _ = jax.lax.while_loop(
             lambda c: c[2], step,
             (finishing, static_lower, jnp.any(finishing) | True))

@@ -75,6 +75,9 @@ def _pool_to_device(pool: QueryPool) -> dict:
         "keys": jnp.asarray(pool.keys),
         "is_write": jnp.asarray(pool.is_write),
         "n_req": jnp.asarray(pool.n_req),
+        "txn_type": jnp.asarray(pool.txn_type),
+        "args": jnp.asarray(pool.args),
+        "aux": jnp.asarray(pool.aux),
     }
 
 
@@ -109,6 +112,9 @@ def make_tick(cfg: Config, plugin, pool_dev: dict):
         keys = jnp.where(free[:, None], pool_dev["keys"][pidx], txn.keys)
         is_write = jnp.where(free[:, None], pool_dev["is_write"][pidx], txn.is_write)
         n_req = jnp.where(free, pool_dev["n_req"][pidx], txn.n_req)
+        txn_type = jnp.where(free, pool_dev["txn_type"][pidx], txn.txn_type)
+        targs = jnp.where(free[:, None], pool_dev["args"][pidx], txn.targs)
+        aux = jnp.where(free[:, None], pool_dev["aux"][pidx], txn.aux)
 
         # timestamp allocation: fresh txns always; restarted txns iff the CC
         # algorithm re-draws per attempt (worker_thread.cpp:492-495)
@@ -129,7 +135,8 @@ def make_tick(cfg: Config, plugin, pool_dev: dict):
         txn = TxnState(status=status, cursor=cursor, ts=ts, pool_idx=pool_idx,
                        restarts=restarts, backoff_until=txn.backoff_until,
                        start_tick=start_tick, first_start_tick=first_start_tick,
-                       keys=keys, is_write=is_write, n_req=n_req)
+                       keys=keys, is_write=is_write, n_req=n_req,
+                       txn_type=txn_type, targs=targs, aux=aux)
         db = plugin.on_start(cfg, db, txn, free | expire)
 
         # ---- 3. commit phase ----
@@ -249,7 +256,7 @@ class Engine:
         cfg = self.cfg
         B, R = cfg.batch_size, self.pool.max_req
         return EngineState(
-            txn=TxnState.empty(B, R),
+            txn=TxnState.empty(B, R, A=self.pool.args.shape[1]),
             db=self.plugin.init_db(cfg, cfg.synth_table_size, B, R),
             data=jnp.zeros(cfg.synth_table_size, jnp.int32),
             stats=_zeros_stats(),

@@ -115,6 +115,9 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
         is_write = jnp.where(free[:, None], pool_dev["is_write"][pidx],
                              txn.is_write)
         n_req = jnp.where(free, pool_dev["n_req"][pidx], txn.n_req)
+        txn_type = jnp.where(free, pool_dev["txn_type"][pidx], txn.txn_type)
+        targs = jnp.where(free[:, None], pool_dev["args"][pidx], txn.targs)
+        aux = jnp.where(free[:, None], pool_dev["aux"][pidx], txn.aux)
 
         redraw = plugin.new_ts_on_restart or cfg.restart_new_ts
         need_ts = free | (expire if redraw else jnp.zeros_like(free))
@@ -136,7 +139,8 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
         txn = TxnState(status=status, cursor=cursor, ts=ts, pool_idx=pool_idx,
                        restarts=restarts, backoff_until=txn.backoff_until,
                        start_tick=start_tick, first_start_tick=first_start_tick,
-                       keys=keys, is_write=is_write, n_req=n_req)
+                       keys=keys, is_write=is_write, n_req=n_req,
+                       txn_type=txn_type, targs=targs, aux=aux)
         db = plugin.on_start(cfg, db, txn, free | expire)
 
         # ---- 2. build + route entries (exchange A) ----
@@ -206,6 +210,9 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
             keys=r_key[:, None],
             is_write=r_iw[:, None],
             n_req=jnp.where(r_live, 1, 0),
+            txn_type=jnp.zeros(Bv, jnp.int32),
+            targs=jnp.zeros((Bv, 1), jnp.int32),
+            aux=jnp.zeros((Bv, 1), jnp.int32),
         )
         vdb = dict(db)
         for f in plugin.txn_db_fields:
@@ -331,6 +338,9 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
             keys=rB_key[:, None],
             is_write=rB_iw[:, None],
             n_req=jnp.where(rB_commit, 1, 0),
+            txn_type=jnp.zeros(Bv, jnp.int32),
+            targs=jnp.zeros((Bv, 1), jnp.int32),
+            aux=jnp.zeros((Bv, 1), jnp.int32),
         )
         vdbB = dict(db)
         if plugin.commit_ts_field:
@@ -425,6 +435,9 @@ class ShardedEngine:
             "keys": jnp.asarray(sel(pool.keys)),
             "is_write": jnp.asarray(sel(pool.is_write)),
             "n_req": jnp.asarray(sel(pool.n_req)),
+            "txn_type": jnp.asarray(sel(pool.txn_type)),
+            "args": jnp.asarray(sel(pool.args)),
+            "aux": jnp.asarray(sel(pool.aux)),
         }
 
         B, R = cfg.batch_size, pool.max_req
@@ -466,7 +479,7 @@ class ShardedEngine:
         def one():
             db = self.plugin.init_db(cfg, rows_local, B, R)
             return ShardState(
-                txn=TxnState.empty(B, R),
+                txn=TxnState.empty(B, R, A=self.pool.args.shape[1]),
                 db=db,
                 data=jnp.zeros(rows_local, jnp.int32),
                 stats={**_zeros_stats(),
