@@ -101,7 +101,8 @@ class Config:
     tpcc_rbk_perc: float = 0.0        # NewOrder forced-rollback rate (the
                                       # reference ships with rbk disabled,
                                       # tpcc_query.cpp:216-217)
-    tpcc_max_orders: int = 1 << 12    # ORDER/ORDERLINE ring depth per district
+    tpcc_max_orders: int = 1 << 12    # ORDER/NEW-ORDER insert ring per shard
+    tpcc_ol_cap: int = 1 << 16        # ORDER-LINE insert ring per shard
     tpcc_hist_cap: int = 1 << 14      # HISTORY insert ring per shard
 
     # --- PPS (reference config.h:235-242) ---

@@ -30,12 +30,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from deneva_tpu import cc as cc_registry
-from deneva_tpu.config import Config, YCSB
+from deneva_tpu import workloads as wl_registry
+from deneva_tpu.config import Config, TPCC
 from deneva_tpu.engine.state import (
     STATUS_BACKOFF, STATUS_FREE, STATUS_RUNNING, STATUS_WAITING,
     TxnState,
 )
-from deneva_tpu.workloads import ycsb
 from deneva_tpu.workloads.base import QueryPool
 
 
@@ -43,6 +43,7 @@ class EngineState(NamedTuple):
     txn: TxnState
     db: dict                  # CC-plugin arrays (per-row and per-slot)
     data: jnp.ndarray         # (n_rows,) int32 — row payload (increment oracle)
+    tables: dict              # workload table columns + insert rings
     stats: dict               # scalar counters
     tick: jnp.ndarray         # int32 scalar
     pool_cursor: jnp.ndarray  # int32 scalar
@@ -56,6 +57,7 @@ STAT_KEYS_I32 = (
     "local_txn_start_cnt",     # admissions
     "twopl_wait_cnt",          # WAIT decisions (parked continuations)
     "write_cnt",               # committed write accesses applied
+    "user_abort_cnt",          # workload rollbacks (TPC-C rbk), not retried
     "measured_ticks",          # post-warmup ticks elapsed
 )
 STAT_KEYS_F32 = (
@@ -81,8 +83,10 @@ def _pool_to_device(pool: QueryPool) -> dict:
     }
 
 
-def make_tick(cfg: Config, plugin, pool_dev: dict):
+def make_tick(cfg: Config, plugin, pool_dev: dict, workload=None):
     Q = pool_dev["keys"].shape[0]
+    if workload is None:
+        workload = wl_registry.get(cfg)
 
     def bump(stats, key, amount, measuring):
         inc = jnp.where(measuring, amount, 0).astype(stats[key].dtype)
@@ -90,6 +94,7 @@ def make_tick(cfg: Config, plugin, pool_dev: dict):
 
     def tick_fn(state: EngineState) -> EngineState:
         txn, db, data, stats = state.txn, state.db, state.data, state.stats
+        tables = state.tables
         t = state.tick
         measuring = t >= cfg.warmup_ticks
 
@@ -141,6 +146,10 @@ def make_tick(cfg: Config, plugin, pool_dev: dict):
 
         # ---- 3. commit phase ----
         finishing = (txn.status == STATUS_RUNNING) & (txn.cursor >= txn.n_req)
+        # workload rollback (TPC-C rbk at TPCC_FIN, tpcc_txn.cpp:485-489):
+        # releases CC state like an abort but frees the slot, no effects
+        ua = workload.user_abort(cfg, txn, finishing)
+        finishing = finishing & ~ua
         ok, db = plugin.validate(cfg, db, txn, finishing, t)
         commit = finishing & ok
         vabort = finishing & ~ok
@@ -150,6 +159,20 @@ def make_tick(cfg: Config, plugin, pool_dev: dict):
         wmask = commit[:, None] & txn.is_write & (ridx < txn.n_req[:, None])
         data = data.at[txn.keys.reshape(-1)].add(
             wmask.reshape(-1).astype(jnp.int32), mode="drop")
+
+        if workload.has_effects:
+            # single-shard: catalog keys are shard-local (part_cnt == 1).
+            # Within-tick effect order follows the COMMIT timestamp (MaaT's
+            # find_bound lower), matching the sharded engine's exchange B.
+            cts = db[plugin.commit_ts_field] if plugin.commit_ts_field \
+                else txn.ts
+            flds = workload.commit_fields(cfg, tables, txn, commit)
+            nmask = (commit[:, None] & (ridx < txn.n_req[:, None]))
+            tables = workload.apply_commit_entries(
+                cfg, tables, txn.keys.reshape(-1), 0,
+                {k: v.reshape(-1) for k, v in flds.items()},
+                jnp.broadcast_to(cts[:, None], txn.keys.shape).reshape(-1),
+                nmask.reshape(-1))
 
         n_commit = jnp.sum(commit.astype(jnp.int32))
         stats = bump(stats, "txn_cnt", n_commit, measuring)
@@ -164,7 +187,9 @@ def make_tick(cfg: Config, plugin, pool_dev: dict):
                      jnp.sum(jnp.where(commit, t - txn.first_start_tick, 0)),
                      measuring)
 
-        status = jnp.where(commit, STATUS_FREE, txn.status)
+        stats = bump(stats, "user_abort_cnt",
+                     jnp.sum(ua.astype(jnp.int32)), measuring)
+        status = jnp.where(commit | ua, STATUS_FREE, txn.status)
         txn = txn._replace(status=status)
 
         # ---- 4. access phase ----
@@ -210,7 +235,7 @@ def make_tick(cfg: Config, plugin, pool_dev: dict):
         restarts2 = jnp.where(abort_now, txn.restarts + 1, txn.restarts)
         txn = txn._replace(status=status, cursor=cursor,
                            backoff_until=backoff_until, restarts=restarts2)
-        db = plugin.on_abort(cfg, db, txn, abort_now)
+        db = plugin.on_abort(cfg, db, txn, abort_now | ua)
 
         # ts wraparound guard: only relative order matters, and every live
         # txn's ts lies within [ts_counter - horizon, ts_counter], so rebase
@@ -230,8 +255,9 @@ def make_tick(cfg: Config, plugin, pool_dev: dict):
             (txn, db, ts_counter))
 
         stats = bump(stats, "measured_ticks", 1, measuring)
-        return EngineState(txn=txn, db=db, data=data, stats=stats,
-                           tick=t + 1, pool_cursor=(state.pool_cursor + n_free) % Q,
+        return EngineState(txn=txn, db=db, data=data, tables=tables,
+                           stats=stats, tick=t + 1,
+                           pool_cursor=(state.pool_cursor + n_free) % Q,
                            ts_counter=ts_counter)
 
     return tick_fn
@@ -243,13 +269,17 @@ class Engine:
     def __init__(self, cfg: Config, pool: QueryPool | None = None):
         self.cfg = cfg
         self.plugin = cc_registry.get(cfg.cc_alg)
+        self.workload = wl_registry.get(cfg)
+        if cfg.workload == TPCC:
+            assert cfg.part_cnt == 1, \
+                "single-shard TPC-C needs part_cnt=1 (use ShardedEngine)"
         if pool is None:
-            if cfg.workload != YCSB:
-                raise NotImplementedError(cfg.workload)
-            pool = ycsb.gen_query_pool(cfg)
+            pool = self.workload.gen_pool(cfg)
         self.pool = pool
+        self.n_rows = self.workload.cc_rows(cfg)
         self.pool_dev = _pool_to_device(pool)
-        self._tick_fn = make_tick(cfg, self.plugin, self.pool_dev)
+        self._tick_fn = make_tick(cfg, self.plugin, self.pool_dev,
+                                  self.workload)
         self._tick_jit = jax.jit(self._tick_fn, donate_argnums=0)
 
     def init_state(self) -> EngineState:
@@ -257,8 +287,9 @@ class Engine:
         B, R = cfg.batch_size, self.pool.max_req
         return EngineState(
             txn=TxnState.empty(B, R, A=self.pool.args.shape[1]),
-            db=self.plugin.init_db(cfg, cfg.synth_table_size, B, R),
-            data=jnp.zeros(cfg.synth_table_size, jnp.int32),
+            db=self.plugin.init_db(cfg, self.n_rows, B, R),
+            data=jnp.zeros(self.n_rows, jnp.int32),
+            tables=self.workload.init_tables(cfg, 0),
             stats=_zeros_stats(),
             tick=jnp.zeros((), jnp.int32),
             pool_cursor=jnp.zeros((), jnp.int32),

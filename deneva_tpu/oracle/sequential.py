@@ -33,7 +33,7 @@ import dataclasses
 
 import numpy as np
 
-from deneva_tpu.config import Config, YCSB
+from deneva_tpu.config import Config
 from deneva_tpu.workloads.base import QueryPool
 
 BIG = np.int64(2**62)
@@ -483,15 +483,16 @@ class SequentialEngine:
 
     def __init__(self, cfg: Config, pool: QueryPool | None = None):
         self.cfg = cfg
+        from deneva_tpu import workloads as wl_registry
+        workload = wl_registry.get(cfg)
         if pool is None:
-            assert cfg.workload == YCSB
-            from deneva_tpu.workloads import ycsb
-            pool = ycsb.gen_query_pool(cfg)
+            pool = workload.gen_pool(cfg)
         self.pool = pool
-        self.man = make_manager(cfg, cfg.synth_table_size)
+        n_rows = workload.cc_rows(cfg)
+        self.man = make_manager(cfg, n_rows)
         B = cfg.batch_size
         self.txns = [SeqTxn(slot=i) for i in range(B)]
-        self.data = np.zeros(cfg.synth_table_size, np.int64)
+        self.data = np.zeros(n_rows, np.int64)
         self.tick = 0
         self.pool_cursor = 0
         self.ts_counter = 1

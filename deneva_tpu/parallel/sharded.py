@@ -50,14 +50,14 @@ from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
 from deneva_tpu import cc as cc_registry
-from deneva_tpu.config import Config, YCSB
+from deneva_tpu import workloads as wl_registry
+from deneva_tpu.config import Config, TPCC
 from deneva_tpu.engine.scheduler import (STAT_KEYS_F32, STAT_KEYS_I32,
                                          _zeros_stats)
 from deneva_tpu.engine.state import (BIG_TS, NULL_KEY, STATUS_BACKOFF,
                                      STATUS_FREE, STATUS_RUNNING,
                                      STATUS_WAITING, TxnState)
 from deneva_tpu.parallel import routing
-from deneva_tpu.workloads import ycsb
 from deneva_tpu.workloads.base import QueryPool
 
 AXIS = "node"
@@ -70,6 +70,7 @@ class ShardState(NamedTuple):
     txn: TxnState              # (B, R) home transactions
     db: dict                   # per-row (rows/N) + per-txn (B,) CC arrays
     data: jnp.ndarray          # (rows/N,) local rows (increment oracle)
+    tables: dict               # workload table columns + insert rings
     stats: dict
     tick: jnp.ndarray
     pool_cursor: jnp.ndarray
@@ -82,11 +83,13 @@ def _flags(iw, held, req, fin):
 
 
 def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
-                      cap: int):
+                      cap: int, workload=None):
     B = cfg.batch_size
     Q = pool_dev["keys"].shape[0]
     R = pool_dev["keys"].shape[1]
     node_stride = n_nodes
+    if workload is None:
+        workload = wl_registry.get(cfg)
 
     def bump(stats, key, amount, measuring):
         inc = jnp.where(measuring, amount, 0).astype(stats[key].dtype)
@@ -94,6 +97,7 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
 
     def tick_fn(state: ShardState, node_id) -> ShardState:
         txn, db, data, stats = state.txn, state.db, state.data, state.stats
+        tables = state.tables
         t = state.tick
         measuring = t >= cfg.warmup_ticks
 
@@ -149,6 +153,9 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
         active = (txn.status == STATUS_RUNNING) | (txn.status == STATUS_WAITING)
         ridx = jnp.broadcast_to(jnp.arange(R, dtype=jnp.int32), (B, R))
         finishing = (txn.status == STATUS_RUNNING) & (txn.cursor >= txn.n_req)
+        # workload rollback (TPC-C rbk): frees the slot, no effects, no votes
+        ua = workload.user_abort(cfg, txn, finishing)
+        finishing = finishing & ~ua
         ent = make_entries(
             txn, active,
             read_locks_held=(plugin.request_all
@@ -319,6 +326,17 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
         oB = origB.reshape(-1)
         sendB["commit"] = cflag_flat[jnp.where(oB >= 0, oB, nE)].astype(
             jnp.int32).reshape(n_nodes, cap)
+        if workload.has_effects:
+            # per-entry effect args (the RFIN payload carrying the
+            # workload's state-machine results to the row owners); computed
+            # on the FINAL commit mask so e.g. TPC-C o_id assignment skips
+            # deferred txns, and gathered through the pack permutation
+            flds = workload.commit_fields(cfg, tables, txn, commit)
+            for f in workload.effect_fields:
+                vflat = jnp.concatenate(
+                    [flds[f].reshape(-1), jnp.zeros(1, flds[f].dtype)])
+                sendB[f] = vflat[jnp.where(oB >= 0, oB, nE)].reshape(
+                    n_nodes, cap)
 
         recvB = routing.exchange(sendB, AXIS)
         rB_key = recvB["key"].reshape(-1)
@@ -352,6 +370,11 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
                        and k != plugin.commit_ts_field}}
         data = data.at[rB_key].add(
             (rB_commit & rB_iw).astype(jnp.int32), mode="drop")
+        if workload.has_effects:
+            tables = workload.apply_commit_entries(
+                cfg, tables, rB_key, node_id,
+                {f: recvB[f].reshape(-1) for f in workload.effect_fields},
+                rB_cts, rB_commit)
 
         # ---- 6. commit/abort bookkeeping (home) ----
         n_commit = jnp.sum(commit.astype(jnp.int32))
@@ -368,7 +391,9 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
         stats = bump(stats, "txn_total_time_ticks",
                      jnp.sum(jnp.where(commit, t - txn.first_start_tick, 0)),
                      measuring)
-        status = jnp.where(commit, STATUS_FREE, status)
+        stats = bump(stats, "user_abort_cnt",
+                     jnp.sum(ua.astype(jnp.int32)), measuring)
+        status = jnp.where(commit | ua, STATUS_FREE, status)
 
         stats = bump(stats, "total_txn_abort_cnt",
                      jnp.sum(abort_now.astype(jnp.int32)), measuring)
@@ -384,7 +409,7 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
         restarts2 = jnp.where(abort_now, txn.restarts + 1, txn.restarts)
         txn = txn._replace(status=status, cursor=cursor,
                            backoff_until=backoff_until, restarts=restarts2)
-        db = plugin.on_abort(cfg, db, txn, abort_now)
+        db = plugin.on_abort(cfg, db, txn, abort_now | ua)
 
         # ---- 7. global ts rebase (all nodes together over ICI) ----
         limit = jnp.int32((3 << 29) // node_stride)
@@ -402,7 +427,8 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
             global_max > limit, _rebase, lambda op: op, (txn, db, ts_counter))
 
         stats = bump(stats, "measured_ticks", 1, measuring)
-        return ShardState(txn=txn, db=db, data=data, stats=stats, tick=t + 1,
+        return ShardState(txn=txn, db=db, data=data, tables=tables,
+                          stats=stats, tick=t + 1,
                           pool_cursor=(state.pool_cursor + n_free) % Q,
                           ts_counter=ts_counter)
 
@@ -418,12 +444,15 @@ class ShardedEngine:
         assert cfg.part_cnt == cfg.node_cnt, "part striping == node striping"
         self.cfg = cfg
         self.plugin = cc_registry.get(cfg.cc_alg)
+        self.workload = wl_registry.get(cfg)
         N = cfg.node_cnt
+        if cfg.workload == TPCC:
+            # commit_fields assigns o_id from the HOME-LOCAL district row
+            assert cfg.first_part_local, "sharded TPC-C needs first_part_local"
         if pool is None:
-            if cfg.workload != YCSB:
-                raise NotImplementedError(cfg.workload)
-            pool = ycsb.gen_query_pool(cfg)
+            pool = self.workload.gen_pool(cfg)
         self.pool = pool
+        self.n_rows = self.workload.cc_rows(cfg)
         devices = devices if devices is not None else jax.devices()[:N]
         assert len(devices) == N, (len(devices), N)
         self.mesh = Mesh(np.array(devices), (AXIS,))
@@ -463,7 +492,7 @@ class ShardedEngine:
             st = jax.tree.map(lambda x: x[0], state)
             pool_dev = {k: v[0] for k, v in pool_shard.items()}
             tick = make_sharded_tick(self.cfg, self.plugin, pool_dev, N,
-                                     self.cap)
+                                     self.cap, self.workload)
             out = tick(st, node_idx[0])
             return jax.tree.map(lambda x: x[None], out)
 
@@ -474,14 +503,15 @@ class ShardedEngine:
         cfg = self.cfg
         N = cfg.node_cnt
         B, R = cfg.batch_size, self.pool.max_req
-        rows_local = cfg.synth_table_size // N
+        rows_local = self.n_rows // N
 
-        def one():
+        def one(part):
             db = self.plugin.init_db(cfg, rows_local, B, R)
             return ShardState(
                 txn=TxnState.empty(B, R, A=self.pool.args.shape[1]),
                 db=db,
                 data=jnp.zeros(rows_local, jnp.int32),
+                tables=self.workload.init_tables(cfg, part),
                 stats={**_zeros_stats(),
                        **{k: jnp.zeros((), jnp.int32)
                           for k in SHARD_STAT_KEYS}},
@@ -490,7 +520,7 @@ class ShardedEngine:
                 ts_counter=jnp.ones((), jnp.int32),
             )
 
-        states = [one() for _ in range(N)]
+        states = [one(p) for p in range(N)]
         stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *states)
         return stacked
 
@@ -527,7 +557,7 @@ class ShardedEngine:
             s = jax.tree.map(lambda x: x[0], st)
             pool_dev = {k: v[0] for k, v in pool_shard.items()}
             tick = make_sharded_tick(self.cfg, self.plugin, pool_dev, N,
-                                     self.cap)
+                                     self.cap, self.workload)
             s = jax.lax.fori_loop(0, n_ticks,
                                   lambda _, x: tick(x, node_idx[0]), s)
             return jax.tree.map(lambda x: x[None], s)

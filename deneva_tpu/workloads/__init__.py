@@ -1,4 +1,18 @@
-from deneva_tpu.workloads.base import QueryPool
+from deneva_tpu.workloads.base import QueryPool, WorkloadPlugin
 from deneva_tpu.workloads import ycsb
 
-__all__ = ["QueryPool", "ycsb"]
+
+def get(cfg) -> WorkloadPlugin:
+    """Workload registry — the rebuild of the reference's compile-time
+    WORKLOAD switch (config.h:40) + per-workload Workload subclasses."""
+    from deneva_tpu.config import TPCC, YCSB
+
+    if cfg.workload == YCSB:
+        return ycsb.YCSBWorkload()
+    if cfg.workload == TPCC:
+        from deneva_tpu.workloads.tpcc import TPCCWorkload
+        return TPCCWorkload()
+    raise NotImplementedError(cfg.workload)
+
+
+__all__ = ["QueryPool", "WorkloadPlugin", "ycsb", "get"]

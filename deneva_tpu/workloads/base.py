@@ -56,11 +56,23 @@ class WorkloadPlugin:
     access footprint plus scalar args.  What distinguishes workloads is how
     queries are generated and what a commit DOES to table data (the
     reference's per-workload TxnManager compute steps + insert_row calls,
-    e.g. benchmarks/tpcc_txn.cpp:500-900).  Effects are applied as one
-    vectorized pass over the committing batch.
+    e.g. benchmarks/tpcc_txn.cpp:500-933).
+
+    Effects are applied per ACCESS ENTRY at the shard that owns the row —
+    the batched analog of the reference executing each state-machine step at
+    the partition holding the row (tpcc_txn.cpp:419-493 remote hops).  The
+    home node computes per-entry effect argument fields (``commit_fields``),
+    the engine ships them with the commit exchange (the RFIN payload), and
+    the owner applies them (``apply_commit_entries``).  On a single shard
+    both halves run in the same tick function.
     """
 
     name = "?"
+    #: True if the workload has commit-time table effects beyond the
+    #: engine's per-row write-count oracle (TPC-C yes, YCSB no).
+    has_effects = False
+    #: names of the per-entry int32 fields shipped with the commit exchange
+    effect_fields: tuple = ()
 
     def gen_pool(self, cfg) -> QueryPool:
         raise NotImplementedError
@@ -69,18 +81,32 @@ class WorkloadPlugin:
         """Global CC-addressable row-space size (engine data array)."""
         raise NotImplementedError
 
-    def init_tables(self, cfg, part: int, n_parts: int) -> dict:
-        """Per-shard device table columns ({} if none beyond the oracle)."""
+    def init_tables(self, cfg, part: int) -> dict:
+        """Shard `part`'s device table columns + insert rings ({} if none)."""
         return {}
 
-    def apply_commit(self, cfg, tables: dict, txn, commit, tick) -> dict:
-        """Apply committing txns' data effects; pure, jit-traceable."""
+    def commit_fields(self, cfg, tables: dict, txn, commit) -> dict:
+        """Home-side per-access effect args for committing txns: name ->
+        (B, R) int32.  May read local tables (e.g. TPC-C o_id assignment
+        from D_NEXT_O_ID, which is home-local under first_part_local)."""
+        return {}
+
+    def apply_commit_entries(self, cfg, tables: dict, key_local, part,
+                             fields: dict, cts, live) -> dict:
+        """Owner-side application of committed entries' effects.
+
+        key_local: (n,) shard-local catalog rows; part: owning shard id
+        (scalar); fields: name -> (n,) shipped effect args; cts: (n,)
+        commit timestamps (deterministic within-tick ordering); live: (n,)
+        mask of entries to apply.  Pure, jit-traceable.
+        """
         return tables
 
     def user_abort(self, cfg, txn, finishing):
         """Mask of finishing txns that roll back by workload logic even if
-        CC validation passed (TPC-C rbk, tpcc_txn.cpp:485-489).  These
-        release CC state like a commit but apply no effects and are not
-        retried."""
+        CC would commit them (TPC-C NewOrder rbk, tpcc_txn.cpp:485-489).
+        These release CC state like an abort but free the slot instead of
+        retrying (the reference ships with rbk disabled, tpcc_query.cpp:220;
+        retrying a deterministic rollback would livelock)."""
         import jax.numpy as jnp
         return jnp.zeros_like(finishing)

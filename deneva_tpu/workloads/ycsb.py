@@ -18,7 +18,22 @@ from __future__ import annotations
 import numpy as np
 
 from deneva_tpu.config import Config
-from deneva_tpu.workloads.base import QueryPool
+from deneva_tpu.workloads.base import QueryPool, WorkloadPlugin
+
+
+class YCSBWorkload(WorkloadPlugin):
+    """YCSB has no commit-time data effects beyond the engine's built-in
+    per-row write-count oracle (the reference's YCSB_1 compute step just
+    reads/overwrites a field, ycsb_txn.cpp:227-246)."""
+
+    name = "YCSB"
+    has_effects = False
+
+    def gen_pool(self, cfg: Config) -> QueryPool:
+        return gen_query_pool(cfg)
+
+    def cc_rows(self, cfg: Config) -> int:
+        return cfg.synth_table_size
 
 
 def zeta(n: int, theta: float) -> float:
