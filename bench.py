@@ -1,13 +1,21 @@
 """Headline benchmark: simulated committed YCSB txns/sec on one chip.
 
 Mirrors the reference's metric of record — committed txns / measured second
-(``tput=`` in statistics/stats.cpp:437-447) — for the BASELINE.json config 2
-shape: YCSB, zipf contention, 50/50 read-write.  Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+(``tput=`` in statistics/stats.cpp:437-447) — on the BASELINE.json config 2
+shape: YCSB, zipf 0.6 contention, 50/50 read-write, 16M rows, 10 req/txn.
 
-vs_baseline is value / 1e6 — the fraction of the 1M txns/s north star
-(BASELINE.md: ">=1M simulated concurrent YCSB txns/s on a v5e-8"; we bench a
-single chip here).
+Two cells are measured (PROFILE.md has the cost model and tuning):
+- **faithful**: acquire_window=1, the reference's sequential state machine
+  (one access arbitrated per txn per tick) — the reference-comparable
+  number and the headline ``value``;
+- **greedy**: acquire_window=10 batch acquisition — the engine's native
+  batched operating point (abort-rate-shifting vs the reference;
+  Config.acquire_window docstring).
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline", ...}.
+vs_baseline scales the faithful number against the north star's per-chip
+share: BASELINE.md targets >=1M txns/s on a v5e-8 (8 chips), i.e. 125k/s
+per chip; this bench runs a single chip.
 """
 
 import json
@@ -19,11 +27,15 @@ import numpy as np
 from deneva_tpu.config import Config
 from deneva_tpu.engine.scheduler import Engine
 
+NORTH_STAR_CLUSTER = 1_000_000   # committed txns/s on a v5e-8 (BASELINE.md)
+NORTH_STAR_CHIPS = 8
 
-def main():
+
+def run_cell(acquire_window: int, batch_size: int, admit_cap: int,
+             n_ticks: int = 300) -> float:
     cfg = Config(
         cc_alg="NO_WAIT",
-        batch_size=16384,
+        batch_size=batch_size,
         synth_table_size=1 << 24,   # 16M rows (paper-scale, BASELINE.md grid)
         req_per_query=10,
         zipf_theta=0.6,
@@ -31,15 +43,15 @@ def main():
         query_pool_size=1 << 16,
         warmup_ticks=0,
         backoff=True,
-        acquire_window=10,  # greedy batch acquisition (see Config docstring)
+        acquire_window=acquire_window,
+        admit_cap=admit_cap,
     )
     eng = Engine(cfg)
-    state = eng.init_state()
-
-    # compile + warm up to steady state; SAME trip count as the timed run —
-    # run_compiled's fori_loop treats n_ticks as static, so a different count
-    # would put a recompile inside the timed window
-    n_ticks = 300
+    # two warmup rounds: the first post-compile dispatch runs ~5x slow
+    # (device power/prefetch state), and the second reaches steady-state
+    # occupancy; SAME trip count as the timed run (fori_loop trip count is
+    # static — a different count would recompile inside the timed window)
+    state = eng.run_compiled(n_ticks)
     state = eng.run_compiled(n_ticks, state)
     committed_before = int(np.asarray(state.stats["txn_cnt"]))
 
@@ -48,13 +60,27 @@ def main():
     jax.block_until_ready(state.stats["txn_cnt"])
     dt = time.perf_counter() - t0
 
-    s = eng.summary(state)
-    tput = (s["txn_cnt"] - committed_before) / dt
+    committed = int(np.asarray(state.stats["txn_cnt"])) - committed_before
+    return committed / dt
+
+
+def main():
+    # admit_cap=1024 is a tuned concurrency throttle for BOTH cells: in the
+    # greedy cell it holds steady-state in-flight txns low enough that the
+    # abort rate stays ~0.16 (uncapped admission drives contention up and
+    # measures ~280k/s vs ~430k/s capped; sweep in PROFILE.md)
+    faithful = run_cell(acquire_window=1, batch_size=8192, admit_cap=1024)
+    greedy = run_cell(acquire_window=10, batch_size=8192, admit_cap=1024)
+    per_chip_star = NORTH_STAR_CLUSTER / NORTH_STAR_CHIPS
     print(json.dumps({
-        "metric": "ycsb_nowait_zipf0.6_tput",
-        "value": round(float(tput), 1),
+        "metric": "ycsb_nowait_zipf0.6_tput_faithful",
+        "value": round(float(faithful), 1),
         "unit": "committed_txns_per_sec",
-        "vs_baseline": round(float(tput) / 1e6, 4),
+        "vs_baseline": round(float(faithful) / per_chip_star, 4),
+        "greedy_tput": round(float(greedy), 1),
+        "note": "value=acquire_window 1 (reference-faithful); greedy_tput="
+                "window 10; vs_baseline = faithful / (1M-cluster north star"
+                " / 8 chips)",
     }))
 
 

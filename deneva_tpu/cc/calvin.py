@@ -56,6 +56,8 @@ class Calvin(CCPlugin):
         B, R = txn.keys.shape
         # Calvin ignores isolation-level release-early hooks: locks are held
         # from grant to wrapup regardless (system/txn.cpp:778-788).
+        # request_all makes every access a request, so the sorted-segment
+        # join (not the cursor-window fast path) is the natural kernel.
         ent = make_entries(txn, active, read_locks_held=True, window=R)
         g, w, a = twopl.arbitrate(ent, "CALVIN")
         return AccessDecision(grant=g.reshape(B, R), wait=w.reshape(B, R),

@@ -27,8 +27,28 @@ from deneva_tpu.engine.state import TxnState, make_entries, NULL_KEY
 class TwoPLPlugin(CCPlugin):
     policy = "NO_WAIT"
 
+    def _window_path(self, cfg: Config) -> bool:
+        """The sort-free window arbitration covers the common isolation
+        levels; READ_UNCOMMITTED's read-bypass and huge windows stay on the
+        sorted-segment join."""
+        from deneva_tpu.config import SERIALIZABLE
+        return (cfg.dense_lock_state
+                and cfg.isolation_level in (SERIALIZABLE, READ_COMMITTED)
+                and cfg.acquire_window <= 8)
+
+    def init_db(self, cfg: Config, n_rows: int, B: int, R: int) -> dict:
+        if self._window_path(cfg):
+            return twopl.init_lock_tmp(n_rows)
+        return {}
+
     def access(self, cfg: Config, db: dict, txn: TxnState, active):
         B, R = txn.keys.shape
+        if self._window_path(cfg):
+            g, w, a, tmp = twopl.arbitrate_window(
+                txn, active, self.policy, db, cfg.acquire_window,
+                read_locks_held=(cfg.isolation_level != READ_COMMITTED))
+            return AccessDecision(grant=g, wait=w, abort=a), {**db, **tmp}
+
         ent = make_entries(
             txn, active,
             read_locks_held=(cfg.isolation_level not in (READ_COMMITTED,

@@ -70,6 +70,25 @@ class Config:
     #: are dropped, and T/O read-timestamp bumps from dropped reads persist).
     acquire_window: int = 1
 
+    #: max fresh admissions per tick (None = batch_size).  TPU-motivated:
+    #: admission's pool fetch is a row gather costing ~linear in rows
+    #: fetched; steady-state admissions/tick ~= commits/tick << B, so a cap
+    #: of B/8 shrinks the fetch 8x with no steady-state effect (ramp-up
+    #: takes a few extra ticks).  The reference has no analog (clients
+    #: issue queries one by one); parity runs leave this None.
+    admit_cap: Optional[int] = None
+
+    #: lock arbitration kernel.  False (default) = the sorted-segment join:
+    #: one bitonic sort of all B*R live entries + prefix reductions, never
+    #:   touching per-row state — measured FASTER on TPU because dynamic
+    #:   gathers from the (rows,) array are latency-bound (~100ns/lane,
+    #:   PROFILE.md) while sorts/scans/scatters are cheap.
+    #: True = the scatter/gather window kernel (cc/twopl.py
+    #:   arbitrate_window): per-row held-lock scratch + a small sort of just
+    #:   the requests; decisions identical (equivalence-tested), kept as the
+    #:   dense-row alternative for hardware where gathers are cheap.
+    dense_lock_state: bool = False
+
     # --- abort/backoff (reference config.h:112-114 ABORT_PENALTY/BACKOFF) ---
     abort_penalty_ticks: int = 1
     abort_penalty_max_ticks: int = 64

@@ -73,18 +73,66 @@ def _zeros_stats() -> dict:
 
 
 def _pool_to_device(pool: QueryPool) -> dict:
-    return {
-        "keys": jnp.asarray(pool.keys),
-        "is_write": jnp.asarray(pool.is_write),
-        "n_req": jnp.asarray(pool.n_req),
-        "txn_type": jnp.asarray(pool.txn_type),
-        "args": jnp.asarray(pool.args),
-        "aux": jnp.asarray(pool.aux),
+    """Pack the host pool for the device admission fetch.
+
+    TPU row gathers cost ~linear in rows * arrays fetched, so the per-access
+    fields are packed into ONE (Q, R) int32 array (key*2+iw; NULL-padded
+    rows keep a negative sentinel) and the per-txn scalars into ONE (Q,)
+    int32.  args/aux ship only when the workload uses them (YCSB's are all
+    zero and are skipped entirely).
+    """
+    assert pool.max_req < 256 and int(pool.txn_type.max()) < 256
+    kw = np.where(pool.keys == np.int32(2**31 - 1), np.int64(-1),
+                  pool.keys.astype(np.int64) * 2 + pool.is_write)
+    out = {
+        "kw": jnp.asarray(kw.astype(np.int32)),
+        "meta": jnp.asarray((pool.n_req.astype(np.int64)
+                             | (pool.txn_type.astype(np.int64) << 8)
+                             ).astype(np.int32)),
     }
+    if pool.args.any():
+        out["args"] = jnp.asarray(pool.args)
+    if pool.aux.any():
+        out["aux"] = jnp.asarray(pool.aux)
+    return out
+
+
+def pool_admit(pool_dev: dict, txn: TxnState, admit, frank, pool_cursor,
+               cap: int, Q: int):
+    """Fetch `cap` pool rows [cursor, cursor+cap) and scatter them into the
+    admitted slots (rank k -> k-th free slot).  Returns the updated per-txn
+    arrays.  Fetching a fixed `cap`-row block instead of gathering one row
+    per slot keeps the slow row-gather proportional to admissions, not B
+    (Config.admit_cap)."""
+    B, R = txn.keys.shape
+    bidx = (pool_cursor + jnp.arange(cap, dtype=jnp.int32)) % Q
+    blk_kw = pool_dev["kw"][bidx]                       # (cap, R)
+    blk_meta = pool_dev["meta"][bidx]                   # (cap,)
+    blk_keys = jnp.where(blk_kw < 0, jnp.int32(2**31 - 1), blk_kw >> 1)
+    blk_iw = (blk_kw >= 0) & ((blk_kw & 1) == 1)
+
+    slots = jnp.arange(B, dtype=jnp.int32)
+    slot_of_rank = jnp.full(cap, B, jnp.int32).at[
+        jnp.where(admit, frank, cap)].set(slots, mode="drop")
+
+    keys = txn.keys.at[slot_of_rank].set(blk_keys, mode="drop")
+    is_write = txn.is_write.at[slot_of_rank].set(blk_iw, mode="drop")
+    n_req = txn.n_req.at[slot_of_rank].set(blk_meta & 0xFF, mode="drop")
+    txn_type = txn.txn_type.at[slot_of_rank].set(
+        (blk_meta >> 8) & 0xFF, mode="drop")
+    pool_idx = txn.pool_idx.at[slot_of_rank].set(bidx, mode="drop")
+    targs = txn.targs
+    if "args" in pool_dev:
+        targs = targs.at[slot_of_rank].set(pool_dev["args"][bidx],
+                                           mode="drop")
+    aux = txn.aux
+    if "aux" in pool_dev:
+        aux = aux.at[slot_of_rank].set(pool_dev["aux"][bidx], mode="drop")
+    return keys, is_write, n_req, txn_type, targs, aux, pool_idx
 
 
 def make_tick(cfg: Config, plugin, pool_dev: dict, workload=None):
-    Q = pool_dev["keys"].shape[0]
+    Q = pool_dev["kw"].shape[0]
     if workload is None:
         workload = wl_registry.get(cfg)
 
@@ -105,21 +153,18 @@ def make_tick(cfg: Config, plugin, pool_dev: dict, workload=None):
 
         # ---- 2. admission from query pool ----
         free = status == STATUS_FREE
+        cap = cfg.admit_cap if cfg.admit_cap is not None else cfg.batch_size
         if plugin.epoch_admission:
             # sequencer batch release: at most epoch_size fresh txns per
             # tick (SEQ_BATCH_TIMER analog, system/sequencer.cpp:283-326)
-            frank0 = jnp.cumsum(free.astype(jnp.int32)) - free.astype(jnp.int32)
-            free = free & (frank0 < cfg.epoch_size)
+            cap = min(cap, cfg.epoch_size)
+        cap = min(cap, cfg.batch_size, Q)
         frank = jnp.cumsum(free.astype(jnp.int32)) - free.astype(jnp.int32)
+        free = free & (frank < cap)
         n_free = jnp.sum(free.astype(jnp.int32))
-        pidx = (state.pool_cursor + frank) % Q
 
-        keys = jnp.where(free[:, None], pool_dev["keys"][pidx], txn.keys)
-        is_write = jnp.where(free[:, None], pool_dev["is_write"][pidx], txn.is_write)
-        n_req = jnp.where(free, pool_dev["n_req"][pidx], txn.n_req)
-        txn_type = jnp.where(free, pool_dev["txn_type"][pidx], txn.txn_type)
-        targs = jnp.where(free[:, None], pool_dev["args"][pidx], txn.targs)
-        aux = jnp.where(free[:, None], pool_dev["aux"][pidx], txn.aux)
+        keys, is_write, n_req, txn_type, targs, aux, pool_idx = pool_admit(
+            pool_dev, txn, free, frank, state.pool_cursor, cap, Q)
 
         # timestamp allocation: fresh txns always; restarted txns iff the CC
         # algorithm re-draws per attempt (worker_thread.cpp:492-495)
@@ -132,7 +177,6 @@ def make_tick(cfg: Config, plugin, pool_dev: dict, workload=None):
         status = jnp.where(free, STATUS_RUNNING, status)
         cursor = jnp.where(free, 0, txn.cursor)
         restarts = jnp.where(free, 0, txn.restarts)
-        pool_idx = jnp.where(free, pidx, txn.pool_idx)
         start_tick = jnp.where(free, t, start_tick)
         first_start_tick = jnp.where(free, t, txn.first_start_tick)
         stats = bump(stats, "local_txn_start_cnt", n_free, measuring)
@@ -157,8 +201,11 @@ def make_tick(cfg: Config, plugin, pool_dev: dict, workload=None):
 
         ridx = jnp.arange(txn.R, dtype=jnp.int32)[None, :]
         wmask = commit[:, None] & txn.is_write & (ridx < txn.n_req[:, None])
-        data = data.at[txn.keys.reshape(-1)].add(
-            wmask.reshape(-1).astype(jnp.int32), mode="drop")
+        # dead lanes scatter to an out-of-bounds index and are dropped
+        # (adding 0 at a real key would still serialize on hot rows)
+        data = data.at[jnp.where(wmask, txn.keys,
+                                 jnp.int32(2**31 - 1)).reshape(-1)].add(
+            1, mode="drop")
 
         if workload.has_effects:
             # single-shard: catalog keys are shard-local (part_cnt == 1).
@@ -208,7 +255,9 @@ def make_tick(cfg: Config, plugin, pool_dev: dict, workload=None):
         prefix = jnp.cumprod(ok.astype(jnp.int32), axis=1)
         new_cursor = jnp.minimum(jnp.sum(prefix, axis=1), txn.n_req)
         fail_pos = jnp.minimum(new_cursor, R - 1)[:, None]
-        at_fail = lambda m: jnp.take_along_axis(m, fail_pos, axis=1)[:, 0]
+        # value at the fail position via masked reduction (gathers are slow
+        # on TPU; an elementwise compare + any() is free)
+        at_fail = lambda m: jnp.any(m & (ridx2 == fail_pos), axis=1)
         blocked = has_req & (new_cursor < txn.n_req)
         wait = blocked & at_fail(dec.wait)
         abort_now = (blocked & at_fail(dec.abort)) | vabort

@@ -85,8 +85,8 @@ def _flags(iw, held, req, fin):
 def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
                       cap: int, workload=None):
     B = cfg.batch_size
-    Q = pool_dev["keys"].shape[0]
-    R = pool_dev["keys"].shape[1]
+    Q = pool_dev["kw"].shape[0]
+    R = pool_dev["kw"].shape[1]
     node_stride = n_nodes
     if workload is None:
         workload = wl_registry.get(cfg)
@@ -107,21 +107,18 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
         start_tick = jnp.where(expire, t, txn.start_tick)
 
         free = status == STATUS_FREE
+        acap = cfg.admit_cap if cfg.admit_cap is not None else cfg.batch_size
         if plugin.epoch_admission:
             # sequencer batch release (SEQ_BATCH_TIMER, sequencer.cpp:283-326)
-            frank0 = jnp.cumsum(free.astype(jnp.int32)) - free.astype(jnp.int32)
-            free = free & (frank0 < cfg.epoch_size)
+            acap = min(acap, cfg.epoch_size)
+        acap = min(acap, cfg.batch_size, Q)
         frank = jnp.cumsum(free.astype(jnp.int32)) - free.astype(jnp.int32)
+        free = free & (frank < acap)
         n_free = jnp.sum(free.astype(jnp.int32))
-        pidx = (state.pool_cursor + frank) % Q
 
-        keys = jnp.where(free[:, None], pool_dev["keys"][pidx], txn.keys)
-        is_write = jnp.where(free[:, None], pool_dev["is_write"][pidx],
-                             txn.is_write)
-        n_req = jnp.where(free, pool_dev["n_req"][pidx], txn.n_req)
-        txn_type = jnp.where(free, pool_dev["txn_type"][pidx], txn.txn_type)
-        targs = jnp.where(free[:, None], pool_dev["args"][pidx], txn.targs)
-        aux = jnp.where(free[:, None], pool_dev["aux"][pidx], txn.aux)
+        from deneva_tpu.engine.scheduler import pool_admit
+        keys, is_write, n_req, txn_type, targs, aux, pool_idx = pool_admit(
+            pool_dev, txn, free, frank, state.pool_cursor, acap, Q)
 
         redraw = plugin.new_ts_on_restart or cfg.restart_new_ts
         need_ts = free | (expire if redraw else jnp.zeros_like(free))
@@ -135,7 +132,6 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
         status = jnp.where(free, STATUS_RUNNING, status)
         cursor = jnp.where(free, 0, txn.cursor)
         restarts = jnp.where(free, 0, txn.restarts)
-        pool_idx = jnp.where(free, pidx, txn.pool_idx)
         start_tick = jnp.where(free, t, start_tick)
         first_start_tick = jnp.where(free, t, txn.first_start_tick)
         stats = bump(stats, "local_txn_start_cnt", n_free, measuring)
@@ -288,7 +284,7 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
         prefix = jnp.cumprod(ok.astype(jnp.int32), axis=1)
         new_cursor = jnp.minimum(jnp.sum(prefix, axis=1), txn.n_req)
         fail_pos = jnp.minimum(new_cursor, R - 1)[:, None]
-        at_fail = lambda m: jnp.take_along_axis(m, fail_pos, axis=1)[:, 0]
+        at_fail = lambda m: jnp.any(m & (ridx == fail_pos), axis=1)
         has_req = active & (txn.cursor < txn.n_req) & ~vabort
         if plugin.never_aborts:
             # deferred (overflowed) txns must not advance on partial info
@@ -368,8 +364,8 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
         db = {**db, **{k: v for k, v in vdbB.items()
                        if k not in plugin.txn_db_fields
                        and k != plugin.commit_ts_field}}
-        data = data.at[rB_key].add(
-            (rB_commit & rB_iw).astype(jnp.int32), mode="drop")
+        data = data.at[jnp.where(rB_commit & rB_iw, rB_key,
+                                 NULL_KEY)].add(1, mode="drop")
         if workload.has_effects:
             tables = workload.apply_commit_entries(
                 cfg, tables, rB_key, node_id,
@@ -460,14 +456,24 @@ class ShardedEngine:
         # per-node query streams: node p serves queries with home_part == p
         Qn = pool.size // N
         sel = lambda a: np.stack([a[p::N][:Qn] for p in range(N)])
+        from deneva_tpu.engine.scheduler import _pool_to_device
+        import dataclasses as _dc
+        stacked = {f: sel(getattr(pool, f))
+                   for f in ("keys", "is_write", "n_req", "home_part",
+                             "txn_type", "args", "aux")}
+        per_node = [
+            _pool_to_device(_dc.replace(
+                pool, **{f: v[p] for f, v in stacked.items()}))
+            for p in range(N)]
+        # args/aux presence can differ per node slice; unify on the union
+        all_keys = set().union(*[set(d) for d in per_node])
+        Qn_, Rn, An = Qn, pool.max_req, pool.args.shape[1]
+        fill = {"args": np.zeros((Qn_, An), pool.args.dtype),
+                "aux": np.zeros((Qn_, Rn), pool.aux.dtype)}
         self.pool_stacked = {
-            "keys": jnp.asarray(sel(pool.keys)),
-            "is_write": jnp.asarray(sel(pool.is_write)),
-            "n_req": jnp.asarray(sel(pool.n_req)),
-            "txn_type": jnp.asarray(sel(pool.txn_type)),
-            "args": jnp.asarray(sel(pool.args)),
-            "aux": jnp.asarray(sel(pool.aux)),
-        }
+            k: jnp.stack([d[k] if k in d else jnp.asarray(fill[k])
+                          for d in per_node])
+            for k in all_keys}
 
         B, R = cfg.batch_size, pool.max_req
         self.cap = max(int(B * R / N * cfg.route_capacity_factor), R)

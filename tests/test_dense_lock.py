@@ -1,0 +1,84 @@
+"""Equivalence of the dense scatter arbitration vs the sorted-segment join.
+
+cc/twopl.py has two implementations of the same decision rules:
+`arbitrate` (bitonic sort + segment reductions) and `arbitrate_window`
+(per-row held-lock scratch + request-only sort).  They must produce
+IDENTICAL schedules, so a
+full engine run under either must match in every stat and every row of the
+data oracle — under contention, where the decision algebra actually bites.
+"""
+
+import numpy as np
+import pytest
+
+from deneva_tpu.config import Config
+from deneva_tpu.engine.scheduler import Engine
+
+
+def run_pair(alg, **kw):
+    base = dict(cc_alg=alg, batch_size=256, synth_table_size=1 << 10,
+                req_per_query=6, zipf_theta=0.8, tup_read_perc=0.5,
+                query_pool_size=1 << 10)
+    base.update(kw)
+    outs = []
+    for dense in (True, False):
+        eng = Engine(Config(dense_lock_state=dense, **base))
+        st = eng.run(40)
+        outs.append((eng.summary(st), np.asarray(st.data)))
+    return outs
+
+
+@pytest.mark.parametrize("alg", ["NO_WAIT", "WAIT_DIE", "CALVIN"])
+def test_single_shard_equivalence(alg):
+    (s1, d1), (s2, d2) = run_pair(alg)
+    assert s1 == s2
+    assert (d1 == d2).all()
+
+
+@pytest.mark.parametrize("alg", ["NO_WAIT", "WAIT_DIE"])
+def test_equivalence_under_greedy_window(alg):
+    (s1, d1), (s2, d2) = run_pair(alg, acquire_window=6)
+    assert s1 == s2
+    assert (d1 == d2).all()
+
+
+def test_equivalence_read_heavy_wait_die():
+    (s1, d1), (s2, d2) = run_pair("WAIT_DIE", tup_read_perc=0.9,
+                                  zipf_theta=0.95)
+    assert s1 == s2
+    assert (d1 == d2).all()
+
+
+@pytest.mark.parametrize("alg", ["NO_WAIT", "WAIT_DIE", "CALVIN"])
+def test_sharded_equivalence(alg):
+    from deneva_tpu.parallel.sharded import ShardedEngine
+    outs = []
+    for dense in (True, False):
+        cfg = Config(cc_alg=alg, dense_lock_state=dense, node_cnt=4,
+                     part_cnt=4, batch_size=32, synth_table_size=1 << 10,
+                     req_per_query=4, zipf_theta=0.8,
+                     query_pool_size=512, mpr=1.0, part_per_txn=4)
+        eng = ShardedEngine(cfg)
+        st = eng.run(25)
+        outs.append((eng.summary(st),
+                     np.concatenate([np.asarray(st.data[i])
+                                     for i in range(4)])))
+    (s1, d1), (s2, d2) = outs
+    assert s1 == s2
+    assert (d1 == d2).all()
+
+
+def test_tpcc_equivalence():
+    outs = []
+    for dense in (True, False):
+        cfg = Config(workload="TPCC", cc_alg="NO_WAIT",
+                     dense_lock_state=dense, batch_size=64, num_wh=4,
+                     query_pool_size=512, cust_per_dist=1000, max_items=64)
+        eng = Engine(cfg)
+        st = eng.run(30)
+        outs.append((eng.summary(st),
+                     {k: np.asarray(v) for k, v in st.tables.items()}))
+    (s1, t1), (s2, t2) = outs
+    assert s1 == s2
+    for k in t1:
+        assert (t1[k] == t2[k]).all(), k
