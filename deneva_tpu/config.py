@@ -129,6 +129,15 @@ class Config:
     max_part_key: int = 1024
     max_product_key: int = 1024
     max_supplier_key: int = 1024
+    # 8-type mix (reference defaults: PERC_PPS_* config.h:235-242)
+    perc_pps_getpart: float = 0.0
+    perc_pps_getproduct: float = 0.0
+    perc_pps_getsupplier: float = 0.0
+    perc_pps_getpartbysupplier: float = 0.0
+    perc_pps_getpartbyproduct: float = 0.2
+    perc_pps_orderproduct: float = 0.6
+    perc_pps_updateproductpart: float = 0.2
+    perc_pps_updatepart: float = 0.0
 
     # --- T/O family ---
     ts_twr: bool = False              # TS_TWR Thomas write rule (config.h:123)

@@ -31,7 +31,7 @@ import numpy as np
 
 from deneva_tpu import cc as cc_registry
 from deneva_tpu import workloads as wl_registry
-from deneva_tpu.config import Config, TPCC
+from deneva_tpu.config import Config
 from deneva_tpu.engine.state import (
     STATUS_BACKOFF, STATUS_FREE, STATUS_RUNNING, STATUS_WAITING,
     TxnState,
@@ -58,17 +58,33 @@ STAT_KEYS_I32 = (
     "twopl_wait_cnt",          # WAIT decisions (parked continuations)
     "write_cnt",               # committed write accesses applied
     "user_abort_cnt",          # workload rollbacks (TPC-C rbk), not retried
+    "vabort_cnt",              # commit-time validation aborts (OCC/MaaT/2PC)
+    "recon_cnt",               # Calvin reconnaissance passes (PPS)
+    "parts_touched",           # sum over commits of distinct partitions
+    "multi_part_txn_cnt",      # commits touching > 1 partition
     "measured_ticks",          # post-warmup ticks elapsed
 )
 STAT_KEYS_F32 = (
     "txn_run_time_ticks",      # sum of short latency (last restart -> commit)
     "txn_total_time_ticks",    # sum of long latency (first start -> commit)
+    # latency decomposition integrals (txn-ticks per scheduler state; the
+    # tensorized lat_* families of stats.cpp:992-999)
+    "lat_process_time",        # txn-ticks spent RUNNING
+    "lat_cc_block_time",       # txn-ticks spent WAITING (parked on a lock)
+    "lat_abort_time",          # txn-ticks spent in BACKOFF
+    "lat_network_time",        # access-entry-ticks shipped to remote owners
 )
+
+#: commit-latency sampling ring (the StatsArr of stats_array.cpp behind the
+#: ccl* percentiles); wraps, so it always holds the most recent commits
+LAT_SAMPLES = 1 << 14
 
 
 def _zeros_stats() -> dict:
     s = {k: jnp.zeros((), jnp.int32) for k in STAT_KEYS_I32}
     s.update({k: jnp.zeros((), jnp.float32) for k in STAT_KEYS_F32})
+    s["arr_lat_short"] = jnp.zeros(LAT_SAMPLES, jnp.int32)
+    s["lat_ring_cursor"] = jnp.zeros((), jnp.int32)
     return s
 
 
@@ -181,8 +197,22 @@ def make_tick(cfg: Config, plugin, pool_dev: dict, workload=None):
         first_start_tick = jnp.where(free, t, txn.first_start_tick)
         stats = bump(stats, "local_txn_start_cnt", n_free, measuring)
 
+        backoff_until = txn.backoff_until
+        if plugin.epoch_admission and workload.recon_types:
+            # Calvin reconnaissance pass (sequencer.cpp:88-114): recon-typed
+            # txns spend one epoch discovering their read/write set before
+            # sequencing — modeled as a one-tick admission deferral
+            is_recon = jnp.zeros_like(free)
+            for tt in workload.recon_types:
+                is_recon = is_recon | (txn_type == tt)
+            is_recon = free & is_recon
+            status = jnp.where(is_recon, STATUS_BACKOFF, status)
+            backoff_until = jnp.where(is_recon, t + 1, backoff_until)
+            stats = bump(stats, "recon_cnt",
+                         jnp.sum(is_recon.astype(jnp.int32)), measuring)
+
         txn = TxnState(status=status, cursor=cursor, ts=ts, pool_idx=pool_idx,
-                       restarts=restarts, backoff_until=txn.backoff_until,
+                       restarts=restarts, backoff_until=backoff_until,
                        start_tick=start_tick, first_start_tick=first_start_tick,
                        keys=keys, is_write=is_write, n_req=n_req,
                        txn_type=txn_type, targs=targs, aux=aux)
@@ -225,6 +255,37 @@ def make_tick(cfg: Config, plugin, pool_dev: dict, workload=None):
         stats = bump(stats, "txn_cnt", n_commit, measuring)
         stats = bump(stats, "write_cnt",
                      jnp.sum(wmask.astype(jnp.int32)), measuring)
+        stats = bump(stats, "vabort_cnt",
+                     jnp.sum(vabort.astype(jnp.int32)), measuring)
+
+        # partitions touched per commit (BaseQuery::partitions_touched,
+        # system/query.h): distinct parts as a popcounted bitmask
+        if cfg.part_cnt > 1 and cfg.part_cnt <= 31:
+            amask = (ridx < txn.n_req[:, None])
+            bits = jnp.where(amask, jnp.int32(1) << (txn.keys % cfg.part_cnt),
+                             0)
+            pbits = jnp.zeros(txn.B, jnp.int32)
+            for r in range(txn.R):
+                pbits = pbits | bits[:, r]
+            npart = jax.lax.population_count(pbits)
+            stats = bump(stats, "parts_touched",
+                         jnp.sum(jnp.where(commit, npart, 0)), measuring)
+            stats = bump(stats, "multi_part_txn_cnt",
+                         jnp.sum((commit & (npart > 1)).astype(jnp.int32)),
+                         measuring)
+        else:
+            stats = bump(stats, "parts_touched", n_commit, measuring)
+
+        # commit-latency sampling ring (StatsArr analog)
+        crank = jnp.cumsum(commit.astype(jnp.int32)) - commit.astype(jnp.int32)
+        rec = commit & measuring
+        pos = jnp.where(rec, (stats["lat_ring_cursor"] + crank) % LAT_SAMPLES,
+                        LAT_SAMPLES)
+        stats = {**stats,
+                 "arr_lat_short": stats["arr_lat_short"].at[pos].set(
+                     t - txn.start_tick, mode="drop"),
+                 "lat_ring_cursor": stats["lat_ring_cursor"]
+                 + jnp.where(measuring, n_commit, 0)}
         stats = bump(stats, "unique_txn_abort_cnt",
                      jnp.sum((commit & (txn.restarts > 0)).astype(jnp.int32)),
                      measuring)
@@ -286,6 +347,17 @@ def make_tick(cfg: Config, plugin, pool_dev: dict, workload=None):
                            backoff_until=backoff_until, restarts=restarts2)
         db = plugin.on_abort(cfg, db, txn, abort_now | ua)
 
+        # latency decomposition integrals: txn-ticks per end-of-tick state
+        stats = bump(stats, "lat_process_time",
+                     jnp.sum((txn.status == STATUS_RUNNING).astype(jnp.int32)),
+                     measuring)
+        stats = bump(stats, "lat_cc_block_time",
+                     jnp.sum((txn.status == STATUS_WAITING).astype(jnp.int32)),
+                     measuring)
+        stats = bump(stats, "lat_abort_time",
+                     jnp.sum((txn.status == STATUS_BACKOFF).astype(jnp.int32)),
+                     measuring)
+
         # ts wraparound guard: only relative order matters, and every live
         # txn's ts lies within [ts_counter - horizon, ts_counter], so rebase
         # all timestamps periodically instead of letting int32 overflow
@@ -319,9 +391,9 @@ class Engine:
         self.cfg = cfg
         self.plugin = cc_registry.get(cfg.cc_alg)
         self.workload = wl_registry.get(cfg)
-        if cfg.workload == TPCC:
+        if self.workload.has_effects:
             assert cfg.part_cnt == 1, \
-                "single-shard TPC-C needs part_cnt=1 (use ShardedEngine)"
+                "single-shard TPC-C/PPS needs part_cnt=1 (use ShardedEngine)"
         if pool is None:
             pool = self.workload.gen_pool(cfg)
         self.pool = pool
@@ -365,7 +437,8 @@ class Engine:
     def summary(self, state: EngineState, wall_seconds: float | None = None) -> dict:
         """Host-side stats in the reference's [summary] vocabulary
         (statistics/stats.cpp:1541-1575)."""
-        s = {k: np.asarray(v).item() for k, v in state.stats.items()}
+        s = {k: np.asarray(v).item() for k, v in state.stats.items()
+             if not k.startswith("arr_")}
         commits = max(s["txn_cnt"], 1)
         out = dict(s)
         out["tput_per_tick"] = s["txn_cnt"] / max(s["measured_ticks"], 1)
@@ -373,6 +446,22 @@ class Engine:
             s["total_txn_abort_cnt"] + commits)
         out["avg_latency_ticks_short"] = s["txn_run_time_ticks"] / commits
         out["avg_latency_ticks_long"] = s["txn_total_time_ticks"] / commits
+        # valid prefix only, as a tuple: summary dicts stay ==-comparable
+        # (determinism tests) and the semantics match ShardedEngine.summary
+        ring = np.asarray(state.stats["arr_lat_short"])
+        n_valid = min(s["lat_ring_cursor"], ring.shape[0])
+        out["ccl_samples"] = tuple(ring[:n_valid].tolist())
+        out["ccl_valid"] = n_valid
         if wall_seconds is not None:
             out["tput"] = s["txn_cnt"] / wall_seconds
         return out
+
+    def summary_line(self, state: EngineState,
+                     wall_seconds: float | None = None,
+                     prog: bool = False) -> str:
+        """The reference's ``[summary]`` key=value line (the contract with
+        scripts/parse_results.py; deneva_tpu/stats.py)."""
+        from deneva_tpu import stats as stats_mod
+        d = stats_mod.reference_summary(self.summary(state, wall_seconds),
+                                        wall_seconds)
+        return stats_mod.format_summary(d, prog=prog)

@@ -19,32 +19,32 @@ from deneva_tpu.oracle.sequential import SequentialEngine
 from deneva_tpu.workloads import ycsb
 
 
+_KEYS = ("txn_cnt", "total_txn_abort_cnt", "abort_rate", "write_cnt")
+
+
+def _pair_dict(cfg: Config, b: dict, b_data_sum: int, seq) -> dict:
+    s = seq.summary()
+    return {
+        "cc_alg": cfg.cc_alg,
+        "batched": {k: b[k] for k in _KEYS},
+        "sequential": {k: s[k] for k in _KEYS},
+        "abort_rate_divergence": abs(b["abort_rate"] - s["abort_rate"]),
+        "tput_ratio": b["txn_cnt"] / max(s["txn_cnt"], 1),
+        "batched_conserved": b_data_sum == b["write_cnt"],
+        "sequential_conserved": int(seq.data.sum()) == s["write_cnt"],
+    }
+
+
 def run_pair(cfg: Config, n_ticks: int) -> dict:
     """Run both engines on one shared pool; return their stats + divergence."""
     pool = ycsb.gen_query_pool(cfg)
 
     eng = Engine(cfg, pool=pool)
     st = eng.run(n_ticks)
-    b = eng.summary(st)
-    b_data = np.asarray(st.data)
 
     seq = SequentialEngine(cfg, pool=pool).run(n_ticks)
-    s = seq.summary()
-
-    out = {
-        "cc_alg": cfg.cc_alg,
-        "batched": {k: b[k] for k in
-                    ("txn_cnt", "total_txn_abort_cnt", "abort_rate",
-                     "write_cnt")},
-        "sequential": {k: s[k] for k in
-                       ("txn_cnt", "total_txn_abort_cnt", "abort_rate",
-                        "write_cnt")},
-        "abort_rate_divergence": abs(b["abort_rate"] - s["abort_rate"]),
-        "tput_ratio": b["txn_cnt"] / max(s["txn_cnt"], 1),
-        "batched_conserved": int(b_data.sum()) == b["write_cnt"],
-        "sequential_conserved": int(seq.data.sum()) == s["write_cnt"],
-    }
-    return out
+    return _pair_dict(cfg, eng.summary(st), int(np.asarray(st.data).sum()),
+                      seq)
 
 
 def parity_table(algs, cfg_kw: dict, n_ticks: int = 60) -> list[dict]:
@@ -53,3 +53,22 @@ def parity_table(algs, cfg_kw: dict, n_ticks: int = 60) -> list[dict]:
         cfg = Config(cc_alg=alg, **cfg_kw)
         rows.append(run_pair(cfg, n_ticks))
     return rows
+
+
+def run_pair_sharded(cfg: Config, n_ticks: int) -> dict:
+    """Multi-shard parity: ShardedEngine on the virtual mesh vs the N-node
+    sequential oracle (SequentialEngine(node_cnt=N)) on the same pool.
+    Abort-rate agreement here covers the whole distributed path — routing,
+    owner-side arbitration, 2PC vote gathering, commit exchange."""
+    pool = ycsb.gen_query_pool(cfg)
+    from deneva_tpu.parallel.sharded import ShardedEngine
+
+    eng = ShardedEngine(cfg, pool=pool)
+    st = eng.run(n_ticks)
+    b = eng.summary(st)
+
+    seq = SequentialEngine(cfg, pool=pool, node_cnt=cfg.node_cnt).run(n_ticks)
+    out = _pair_dict(cfg, b, eng.global_data_sum(st), seq)
+    out["node_cnt"] = cfg.node_cnt
+    out["route_overflow_abort_cnt"] = b.get("route_overflow_abort_cnt", 0)
+    return out

@@ -44,6 +44,7 @@ FREE, RUNNING, WAITING, BACKOFF = 0, 1, 2, 3
 @dataclasses.dataclass
 class SeqTxn:
     slot: int
+    node: int = 0       # home node (N-node oracle mode)
     tid: int = 0        # unique per admitted query; stable across restarts
                         # (the reference txn_id: worker_thread.cpp:453-458)
     status: int = FREE
@@ -481,7 +482,17 @@ class SequentialEngine:
     """Drives the same slot/tick protocol as engine/scheduler.py, with the
     reference-rule Manager deciding each access sequentially in ts order."""
 
-    def __init__(self, cfg: Config, pool: QueryPool | None = None):
+    def __init__(self, cfg: Config, pool: QueryPool | None = None,
+                 node_cnt: int | None = None):
+        """node_cnt > 1 replays the ShardedEngine's protocol: per-node slot
+        banks and pool streams (pool rows p, p+N, ... — the pool_stacked
+        selection of parallel/sharded.py) and node-interleaved unique
+        timestamps ts = (counter_p + rank) * N + p.  The per-row decision
+        rules are unchanged — the sharded engine resolves remote access and
+        commit exchanges within the same tick, so locality is invisible to
+        CC decisions (no extra latency model is needed); routing-capacity
+        overflow aborts are the one batched-side effect with no sequential
+        analog (measured ~0 at default route_capacity_factor)."""
         self.cfg = cfg
         from deneva_tpu import workloads as wl_registry
         workload = wl_registry.get(cfg)
@@ -491,11 +502,14 @@ class SequentialEngine:
         n_rows = workload.cc_rows(cfg)
         self.man = make_manager(cfg, n_rows)
         B = cfg.batch_size
-        self.txns = [SeqTxn(slot=i) for i in range(B)]
+        self.N = node_cnt if node_cnt is not None else 1
+        self.txns = [SeqTxn(slot=i) for i in range(B * self.N)]
+        for i, txn in enumerate(self.txns):
+            txn.node = i // B
         self.data = np.zeros(n_rows, np.int64)
         self.tick = 0
-        self.pool_cursor = 0
-        self.ts_counter = 1
+        self.pool_cursor = [0] * self.N      # per-node stream cursors
+        self.ts_counter = [1] * self.N
         self.next_tid = 1
         self.stats = dict(txn_cnt=0, total_txn_abort_cnt=0,
                           unique_txn_abort_cnt=0, write_cnt=0,
@@ -508,6 +522,24 @@ class SequentialEngine:
             self._tick()
         return self
 
+    def _draw_ts(self, node: int) -> int:
+        """Node-interleaved unique ts (parallel/sharded.py:127-129);
+        N=1 degenerates to the single-shard counter (node is always 0)."""
+        ts = self.ts_counter[node] * self.N + node
+        self.ts_counter[node] += 1
+        return ts
+
+    def _pool_row(self, node: int) -> int:
+        """Per-node pool stream: rows node, node+N, ... (the pool_stacked
+        selection, parallel/sharded.py)."""
+        if self.N == 1:
+            q = self.pool_cursor[0] % self.pool.size
+        else:
+            qn = self.pool.size // self.N
+            q = node + self.N * (self.pool_cursor[node] % qn)
+        self.pool_cursor[node] += 1
+        return q
+
     def _tick(self):
         cfg, man, t = self.cfg, self.man, self.tick
         redraw = man.needs_new_ts_on_restart or cfg.restart_new_ts
@@ -518,19 +550,18 @@ class SequentialEngine:
                 txn.status = RUNNING
                 txn.start_tick = t
                 if redraw:
-                    txn.ts = self.ts_counter
-                    self.ts_counter += 1
+                    txn.ts = self._draw_ts(txn.node)
                 man.on_start(txn)
 
-        # 2. admission (slot order; epoch cap for Calvin)
+        # 2. admission (per node in slot order; epoch cap for Calvin)
         plugin_epoch = cfg.cc_alg == "CALVIN"
-        admitted = 0
+        admitted = [0] * self.N
         for txn in self.txns:
             if txn.status != FREE:
                 continue
-            if plugin_epoch and admitted >= cfg.epoch_size:
-                break
-            q = self.pool_cursor % self.pool.size
+            if plugin_epoch and admitted[txn.node] >= cfg.epoch_size:
+                continue
+            q = self._pool_row(txn.node)
             txn.keys = self.pool.keys[q]
             txn.is_write = self.pool.is_write[q]
             txn.n_req = int(self.pool.n_req[q])
@@ -540,33 +571,44 @@ class SequentialEngine:
             txn.restarts = 0
             txn.status = RUNNING
             txn.start_tick = t
-            txn.ts = self.ts_counter
-            self.ts_counter += 1
-            self.pool_cursor += 1
-            admitted += 1
+            txn.ts = self._draw_ts(txn.node)
+            admitted[txn.node] += 1
             self.stats["local_txn_start_cnt"] += 1
             man.on_start(txn)
 
-        # 3. commit phase (ts order; validation serialized like the batch)
+        # 3/4. commit + access phases.  Phase ORDER differs by topology,
+        # mirroring the two batched engines:
+        # - single-shard tick: commit FIRST (lock release before this
+        #   tick's arbitration, engine/scheduler.py phase 3 -> 4);
+        # - sharded tick: access arbitration happens in exchange A BEFORE
+        #   the commit exchange B, so finishing txns' locks stay held
+        #   through this tick's arbitration (parallel/sharded.py) — the
+        #   analog of the reference holding locks across the 2PC
+        #   prepare/finish rounds (system/txn.cpp:487-554).
         finishing = [x for x in self.txns
                      if x.status == RUNNING and x.cursor >= x.n_req]
         val_aborted = set()
-        for txn in sorted(finishing, key=lambda x: x.ts):
-            if man.validate(txn, t):
-                man.commit(txn, t)
-                for r in range(txn.n_req):
-                    if txn.is_write[r]:
-                        self.data[int(txn.keys[r])] += 1
-                        self.stats["write_cnt"] += 1
-                self.stats["txn_cnt"] += 1
-                if txn.restarts > 0:
-                    self.stats["unique_txn_abort_cnt"] += 1
-                txn.status = FREE
-            else:
-                val_aborted.add(txn.slot)
-                self._abort(txn)
 
-        # 4. access phase (ts order, window accesses per txn)
+        def commit_phase():
+            for txn in sorted(finishing, key=lambda x: x.ts):
+                if man.validate(txn, t):
+                    man.commit(txn, t)
+                    for r in range(txn.n_req):
+                        if txn.is_write[r]:
+                            self.data[int(txn.keys[r])] += 1
+                            self.stats["write_cnt"] += 1
+                    self.stats["txn_cnt"] += 1
+                    if txn.restarts > 0:
+                        self.stats["unique_txn_abort_cnt"] += 1
+                    txn.status = FREE
+                else:
+                    val_aborted.add(txn.slot)   # slots globally unique
+                    self._abort(txn)
+
+        if self.N == 1:
+            commit_phase()
+
+        # access phase (ts order, window accesses per txn)
         active = [x for x in self.txns
                   if x.status in (RUNNING, WAITING)
                   and x.slot not in val_aborted and x.cursor < x.n_req]
@@ -598,6 +640,9 @@ class SequentialEngine:
                 else:
                     self._abort(txn)
                     break
+
+        if self.N > 1:
+            commit_phase()
 
         self.tick += 1
 

@@ -136,8 +136,20 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
         first_start_tick = jnp.where(free, t, txn.first_start_tick)
         stats = bump(stats, "local_txn_start_cnt", n_free, measuring)
 
+        backoff_until = txn.backoff_until
+        if plugin.epoch_admission and workload.recon_types:
+            # Calvin recon pass (sequencer.cpp:88-114): one-epoch deferral
+            is_recon = jnp.zeros_like(free)
+            for tt in workload.recon_types:
+                is_recon = is_recon | (txn_type == tt)
+            is_recon = free & is_recon
+            status = jnp.where(is_recon, STATUS_BACKOFF, status)
+            backoff_until = jnp.where(is_recon, t + 1, backoff_until)
+            stats = bump(stats, "recon_cnt",
+                         jnp.sum(is_recon.astype(jnp.int32)), measuring)
+
         txn = TxnState(status=status, cursor=cursor, ts=ts, pool_idx=pool_idx,
-                       restarts=restarts, backoff_until=txn.backoff_until,
+                       restarts=restarts, backoff_until=backoff_until,
                        start_tick=start_tick, first_start_tick=first_start_tick,
                        keys=keys, is_write=is_write, n_req=n_req,
                        txn_type=txn_type, targs=targs, aux=aux)
@@ -378,6 +390,37 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
         stats = bump(stats, "write_cnt", jnp.sum(
             (commit[:, None] & txn.is_write
              & (ridx < txn.n_req[:, None])).astype(jnp.int32)), measuring)
+        stats = bump(stats, "vabort_cnt",
+                     jnp.sum(vabort.astype(jnp.int32)), measuring)
+
+        # partitions touched per commit (partitions_touched analog)
+        if n_nodes > 1 and n_nodes <= 31:
+            amask = ridx < txn.n_req[:, None]
+            bits = jnp.where(amask, jnp.int32(1) << (txn.keys % n_nodes), 0)
+            pbits = jnp.zeros(B, jnp.int32)
+            for r in range(R):
+                pbits = pbits | bits[:, r]
+            npart = jax.lax.population_count(pbits)
+            stats = bump(stats, "parts_touched",
+                         jnp.sum(jnp.where(commit, npart, 0)), measuring)
+            stats = bump(stats, "multi_part_txn_cnt",
+                         jnp.sum((commit & (npart > 1)).astype(jnp.int32)),
+                         measuring)
+        else:
+            stats = bump(stats, "parts_touched", n_commit, measuring)
+
+        # commit-latency sampling ring (StatsArr analog)
+        from deneva_tpu.engine.scheduler import LAT_SAMPLES
+        crank = jnp.cumsum(commit.astype(jnp.int32)) - commit.astype(jnp.int32)
+        rec = commit & measuring
+        rpos = jnp.where(rec,
+                         (stats["lat_ring_cursor"] + crank) % LAT_SAMPLES,
+                         LAT_SAMPLES)
+        stats = {**stats,
+                 "arr_lat_short": stats["arr_lat_short"].at[rpos].set(
+                     t - txn.start_tick, mode="drop"),
+                 "lat_ring_cursor": stats["lat_ring_cursor"]
+                 + jnp.where(measuring, n_commit, 0)}
         stats = bump(stats, "unique_txn_abort_cnt",
                      jnp.sum((commit & (txn.restarts > 0)).astype(jnp.int32)),
                      measuring)
@@ -406,6 +449,21 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
         txn = txn._replace(status=status, cursor=cursor,
                            backoff_until=backoff_until, restarts=restarts2)
         db = plugin.on_abort(cfg, db, txn, abort_now | ua)
+
+        # latency decomposition integrals (txn-ticks per end-of-tick state;
+        # network = entry-ticks shipped to remote owners this tick)
+        stats = bump(stats, "lat_process_time",
+                     jnp.sum((txn.status == STATUS_RUNNING).astype(jnp.int32)),
+                     measuring)
+        stats = bump(stats, "lat_cc_block_time",
+                     jnp.sum((txn.status == STATUS_WAITING).astype(jnp.int32)),
+                     measuring)
+        stats = bump(stats, "lat_abort_time",
+                     jnp.sum((txn.status == STATUS_BACKOFF).astype(jnp.int32)),
+                     measuring)
+        stats = bump(stats, "lat_network_time",
+                     jnp.sum((live_e & (dest != node_id)).astype(jnp.int32)),
+                     measuring)
 
         # ---- 7. global ts rebase (all nodes together over ICI) ----
         limit = jnp.int32((3 << 29) // node_stride)
@@ -579,9 +637,10 @@ class ShardedEngine:
                 ) -> dict:
         """Cluster-wide stats: per-node counters summed, like the scripts
         summing per-node tput (plot_helper.py:49-68)."""
-        s = {k: float(np.asarray(v).sum()) for k, v in state.stats.items()}
-        s = {k: int(v) if k in STAT_KEYS_I32 + SHARD_STAT_KEYS else v
-             for k, v in s.items()}
+        s = {k: float(np.asarray(v).sum()) for k, v in state.stats.items()
+             if not k.startswith("arr_")}
+        s = {k: int(v) if k in STAT_KEYS_I32 + SHARD_STAT_KEYS
+             + ("lat_ring_cursor",) else v for k, v in s.items()}
         commits = max(s["txn_cnt"], 1)
         out = dict(s)
         out["measured_ticks"] = int(np.asarray(state.stats["measured_ticks"]
@@ -591,9 +650,26 @@ class ShardedEngine:
             s["total_txn_abort_cnt"] + commits)
         out["avg_latency_ticks_short"] = s["txn_run_time_ticks"] / commits
         out["avg_latency_ticks_long"] = s["txn_total_time_ticks"] / commits
+        # latency ring: concatenate each node's valid prefix
+        rings = np.asarray(state.stats["arr_lat_short"])
+        curs = np.asarray(state.stats["lat_ring_cursor"])
+        parts = [rings[i][:min(int(curs[i]), rings.shape[1])]
+                 for i in range(rings.shape[0])]
+        samples = (np.concatenate(parts) if parts
+                   else np.zeros(0, np.int32))
+        out["ccl_samples"] = tuple(samples.tolist())
+        out["ccl_valid"] = samples.shape[0]
         if wall_seconds is not None:
             out["tput"] = s["txn_cnt"] / wall_seconds
         return out
+
+    def summary_line(self, state: ShardState,
+                     wall_seconds: float | None = None,
+                     prog: bool = False) -> str:
+        from deneva_tpu import stats as stats_mod
+        d = stats_mod.reference_summary(self.summary(state, wall_seconds),
+                                        wall_seconds)
+        return stats_mod.format_summary(d, prog=prog)
 
     def global_data_sum(self, state: ShardState) -> int:
         return int(np.asarray(state.data).sum())

@@ -1,0 +1,116 @@
+"""Stats output contract — the reference's ``[summary]`` key=value line.
+
+The reference combines ~300 per-thread counters and dumps one
+``[summary] k=v,k=v,...`` line (statistics/stats.cpp:425-1575) that
+``scripts/parse_results.py`` consumes.  This module emits the same contract
+from the engine's device-resident counters:
+
+- ``reference_summary``  maps the engine's stats dict onto the reference's
+  key NAMES (stats.cpp:446-470 execution block, :992-999 latency
+  decomposition, :392-417 ``ccl*`` latency percentiles);
+- ``format_summary``     renders the ``[summary]`` / ``[prog]`` line;
+- ``parse_summary``      is a port of parse_results.py:19-37 (get_summary +
+  process_results) proving the line round-trips.
+
+Units: the engine's native time unit is the scheduler TICK.  Passing
+``wall_seconds`` converts every time-valued key to seconds (the reference's
+unit) using the measured mean tick duration; otherwise times are in ticks.
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+#: percentiles of the commit-latency sampling array, matching the
+#: client_client_latency dump (stats.cpp:392-417; StatsArr quantiles,
+#: statistics/stats_array.cpp).  ccl0/ccl100 are min/max.
+CCL_PERCENTILES = (0, 1, 10, 25, 50, 75, 90, 95, 96, 97, 98, 99, 100)
+
+
+def latency_percentiles(samples, n_valid: int) -> dict:
+    """ccl* keys from the device sampling ring (first n_valid entries are
+    meaningful; the ring wraps so they are the most recent commits)."""
+    samples = np.asarray(samples)
+    n = int(min(n_valid, samples.shape[0]))
+    if n == 0:
+        return {f"ccl{p}": 0.0 for p in CCL_PERCENTILES}
+    s = np.sort(samples[:n].astype(np.float64))
+    out = {}
+    for p in CCL_PERCENTILES:
+        idx = min(n - 1, max(0, int(n * p / 100) - (1 if p == 100 else 0)))
+        out[f"ccl{p}"] = float(s[idx])
+    out["ccl0"] = float(s[0])
+    out["ccl100"] = float(s[-1])
+    return out
+
+
+def reference_summary(s: dict, wall_seconds: float | None = None) -> dict:
+    """Engine stats dict -> reference-vocabulary flat dict.
+
+    `s` is Engine/ShardedEngine.summary() output (which itself keeps the
+    raw counter names); adds the reference's derived keys.
+    """
+    ticks = max(s.get("measured_ticks", 0), 1)
+    tick_sec = (wall_seconds / ticks) if wall_seconds else 1.0
+    commits = max(s["txn_cnt"], 1)
+
+    out = {
+        "total_runtime": ticks * tick_sec,
+        "tput": s["txn_cnt"] / (ticks * tick_sec),
+        "txn_cnt": s["txn_cnt"],
+        "local_txn_start_cnt": s["local_txn_start_cnt"],
+        "total_txn_commit_cnt": s["txn_cnt"],
+        "local_txn_commit_cnt": s["txn_cnt"],
+        "total_txn_abort_cnt": s["total_txn_abort_cnt"],
+        "unique_txn_abort_cnt": s["unique_txn_abort_cnt"],
+        "txn_run_time": s["txn_run_time_ticks"] * tick_sec,
+        "txn_run_avg_time": s["txn_run_time_ticks"] * tick_sec / commits,
+        "record_write_cnt": s["write_cnt"],
+        "parts_touched": s.get("parts_touched", s["txn_cnt"]),
+        "avg_parts_touched": s.get("parts_touched", s["txn_cnt"]) / commits,
+        "multi_part_txn_cnt": s.get("multi_part_txn_cnt", 0),
+        "single_part_txn_cnt": s["txn_cnt"] - s.get("multi_part_txn_cnt", 0),
+        # latency decomposition (stats.cpp:992-999): integrals of txn-ticks
+        # spent per scheduler state; lat_other_time covers the commit tick
+        "lat_cc_block_time": s.get("lat_cc_block_time", 0.0) * tick_sec,
+        "lat_abort_time": s.get("lat_abort_time", 0.0) * tick_sec,
+        "lat_process_time": s.get("lat_process_time", 0.0) * tick_sec,
+        "lat_network_time": s.get("lat_network_time", 0.0) * tick_sec,
+        "lat_work_queue_time": 0.0,   # no queueing: every txn runs per tick
+        "lat_msg_queue_time": 0.0,    # exchanges happen inside the tick
+        # CC counters
+        "twopl_wait_cnt": s.get("twopl_wait_cnt", 0),
+        "cc_vabort_cnt": s.get("vabort_cnt", 0),
+        "user_abort_cnt": s.get("user_abort_cnt", 0),
+    }
+    if "ccl_samples" in s:
+        ccl = latency_percentiles(s["ccl_samples"], s.get("ccl_valid", 0))
+        out.update({k: v * tick_sec for k, v in ccl.items()})
+    return out
+
+
+def format_summary(d: dict, prog: bool = False) -> str:
+    """Render the reference's output line (stats.cpp:1541-1575)."""
+    tag = "[prog]" if prog else "[summary]"
+    parts = []
+    for k, v in d.items():
+        if isinstance(v, float):
+            parts.append(f"{k}={v:f}")
+        else:
+            parts.append(f"{k}={v}")
+    return tag + " " + ",".join(parts)
+
+
+def parse_summary(line: str) -> dict:
+    """Port of parse_results.py get_summary/process_results (:19-37)."""
+    if not re.search("summary", line):
+        return {}
+    line = line.rstrip("\n")
+    line = line[10:]                       # remove '[summary] '
+    out = {}
+    for r in re.split(",", line):
+        name, val = re.split("=", r)
+        out[name] = float(val)
+    return out

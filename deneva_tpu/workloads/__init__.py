@@ -5,13 +5,16 @@ from deneva_tpu.workloads import ycsb
 def get(cfg) -> WorkloadPlugin:
     """Workload registry — the rebuild of the reference's compile-time
     WORKLOAD switch (config.h:40) + per-workload Workload subclasses."""
-    from deneva_tpu.config import TPCC, YCSB
+    from deneva_tpu.config import PPS, TPCC, YCSB
 
     if cfg.workload == YCSB:
         return ycsb.YCSBWorkload()
     if cfg.workload == TPCC:
         from deneva_tpu.workloads.tpcc import TPCCWorkload
         return TPCCWorkload()
+    if cfg.workload == PPS:
+        from deneva_tpu.workloads.pps import PPSWorkload
+        return PPSWorkload()
     raise NotImplementedError(cfg.workload)
 
 

@@ -73,6 +73,11 @@ class WorkloadPlugin:
     has_effects = False
     #: names of the per-entry int32 fields shipped with the commit exchange
     effect_fields: tuple = ()
+    #: txn types that need a Calvin reconnaissance pass before sequencing
+    #: (PPS GETPARTBY*/ORDERPRODUCT, system/sequencer.cpp:88-114): under
+    #: epoch admission these are admitted one tick late — the observable
+    #: extra epoch of recon latency (deneva_tpu/workloads/pps.py docstring)
+    recon_types: tuple = ()
 
     def gen_pool(self, cfg) -> QueryPool:
         raise NotImplementedError

@@ -1,0 +1,280 @@
+"""PPS (Product-Parts-Supplier) — the reference's third workload.
+
+The reference implements PPS as 8 transaction types over 5 tables
+(benchmarks/pps.h:32-71 state machines, PPS_schema.txt), with secondary
+lookups through the non-unique USES / SUPPLIES indexes: GETPARTBY* and
+ORDERPRODUCT read a product/supplier row, then walk its parts chain —
+USES/SUPPLIES row -> part_key -> PARTS row — one link per state-machine
+loop (pps_txn.cpp:485-630, loop-backs at :352-470).
+
+Tensorized mapping:
+
+- **entity tables** PARTS / PRODUCTS / SUPPLIERS: catalog rows striped by
+  raw key % part_cnt (pps_helper.cpp:19-29).  The only mutable numeric
+  column is PART_AMOUNT (init 1000, pps_wl.cpp:125).
+- **association tables** USES / SUPPLIES: one catalog row per chain slot
+  (product, i) — the chain is the loader's DEDUPED, ASCENDING set of
+  g_max_parts_per draws (std::set iteration, pps_wl.cpp:200-243).  The
+  chain lives on the PRODUCT/SUPPLIER's shard like index_insert_nonunique.
+- **access lists**: the chain walk unrolled —
+    GETPART(BY nothing)/GETPRODUCT/GETSUPPLIER: one RD;
+    GETPARTBYPRODUCT:  PRODUCTS RD, then per link USES RD + PARTS RD;
+    GETPARTBYSUPPLIER: SUPPLIERS RD, then SUPPLIES RD + PARTS RD;
+    ORDERPRODUCT:      PRODUCTS RD, then USES RD + PARTS WR (amount - 1,
+                       run_orderproduct_5);
+    UPDATEPRODUCTPART: USES[product, 0] WR := new part key
+                       ("always the first part", pps_txn.cpp:968);
+    UPDATEPART:        PARTS WR (amount + 100, run_updatepart_1).
+
+Documented divergences:
+- Part-chain footprints are resolved against the LOADER's USES/SUPPLIES
+  mapping.  The reference re-reads the (mutable) USES row at run time, so
+  after an UPDATEPRODUCTPART its later GETPARTBY* txns can walk to a
+  different part.  CC-wise the footprint distributions are identical (both
+  the initial mapping and the update draws are uniform); the USES row
+  write itself is fully modeled.
+- The Calvin reconnaissance pass (sequencer.cpp:88-114): the reference
+  runs GETPARTBY*/ORDERPRODUCT once as a read-only recon txn to discover
+  part_keys, then re-submits with the known set.  Here the pool already
+  knows the footprint, so recon is modeled as its observable cost: under
+  CALVIN these types are admitted one tick late (one epoch of recon
+  latency, counted in pps_recon_cnt via user-visible latency); the recon
+  pass's transient read locks are not replayed.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from deneva_tpu.config import Config
+from deneva_tpu.storage.catalog import Catalog
+from deneva_tpu.workloads.base import QueryPool, WorkloadPlugin
+
+# txn types (reference pps.h PPSTxnType order)
+PPS_GETPART = 1
+PPS_GETPRODUCT = 2
+PPS_GETSUPPLIER = 3
+PPS_GETPARTBYSUPPLIER = 4
+PPS_GETPARTBYPRODUCT = 5
+PPS_ORDERPRODUCT = 6
+PPS_UPDATEPRODUCTPART = 7
+PPS_UPDATEPART = 8
+
+# per-access effect roles (aux low 3 bits; payload above)
+ROLE_NONE = 0
+ROLE_ORDER = 1       # PARTS: amount -= 1   (run_orderproduct_5)
+ROLE_UPDPART = 2     # PARTS: amount += 100 (run_updatepart_1)
+ROLE_SETUSES = 3     # USES: part_key := payload (run_updateproductpart_1)
+
+TA_PRODUCT, TA_PART, TA_SUPPLIER = 0, 1, 2
+N_TARGS = 3
+
+
+def catalog(cfg: Config) -> Catalog:
+    P = cfg.part_cnt
+    loc = lambda k: k // P + 1          # keys are 1-based, striped k % P
+    cat = Catalog(P)
+    cat.add("PARTS", loc(cfg.max_part_key))
+    cat.add("PRODUCTS", loc(cfg.max_product_key))
+    cat.add("SUPPLIERS", loc(cfg.max_supplier_key))
+    cat.add("USES", loc(cfg.max_product_key) * cfg.max_parts_per)
+    cat.add("SUPPLIES", loc(cfg.max_supplier_key) * cfg.max_parts_per)
+    assert cat.rows_global < 1 << 30
+    return cat
+
+
+def _chains(rng, n_entities: int, cfg: Config) -> list[np.ndarray]:
+    """Loader association chains: per entity, the deduped ascending set of
+    max_parts_per uniform part draws (pps_wl.cpp:200-243)."""
+    out = []
+    for _ in range(n_entities):
+        draws = rng.integers(1, cfg.max_part_key + 1, cfg.max_parts_per)
+        out.append(np.unique(draws))    # dedup + ascending (std::set)
+    return out
+
+
+class PPSWorkload(WorkloadPlugin):
+    name = "PPS"
+    has_effects = True
+    effect_fields = ("role", "earg")
+    recon_types = (PPS_GETPARTBYSUPPLIER, PPS_GETPARTBYPRODUCT,
+                   PPS_ORDERPRODUCT)
+
+    def _load(self, cfg: Config):
+        rng = np.random.default_rng([cfg.seed, 0x995])
+        uses = _chains(rng, cfg.max_product_key + 1, cfg)      # 1-based
+        supplies = _chains(rng, cfg.max_supplier_key + 1, cfg)
+        return rng, uses, supplies
+
+    def gen_pool(self, cfg: Config, seed: int | None = None) -> QueryPool:
+        rng, uses, supplies = self._load(cfg)
+        cat = catalog(cfg)
+        P = cfg.part_cnt
+        Q = cfg.query_pool_size
+        L = cfg.max_parts_per
+        Rmax = 1 + 2 * L
+
+        mix = np.array([cfg.perc_pps_getpart, cfg.perc_pps_getproduct,
+                        cfg.perc_pps_getsupplier,
+                        cfg.perc_pps_getpartbysupplier,
+                        cfg.perc_pps_getpartbyproduct,
+                        cfg.perc_pps_orderproduct,
+                        cfg.perc_pps_updateproductpart,
+                        cfg.perc_pps_updatepart], np.float64)
+        assert abs(mix.sum() - 1.0) < 1e-6, "perc_pps_* must sum to 1"
+        cum = np.cumsum(mix)
+        draw = rng.random(Q)
+        ttype = (np.searchsorted(cum, draw, side="right") + 1).clip(1, 8)
+
+        home_part = np.arange(Q, dtype=np.int64) % P
+
+        def pick(maxk):
+            # FIRST_PART_LOCAL: uniform over the home part's keys
+            # (pps_query.cpp:223-227); keys are 1-based, striped k % P
+            assert maxk >= P, "need at least one key per partition"
+            if cfg.first_part_local:
+                first = np.where(home_part > 0, home_part, P)
+                count = (maxk - first) // P + 1
+                return first + P * (rng.integers(0, 1 << 30, Q) % count)
+            return rng.integers(1, maxk + 1, Q)
+
+        part_k = pick(cfg.max_part_key)
+        product_k = pick(cfg.max_product_key)
+        supplier_k = pick(cfg.max_supplier_key)
+
+        key = lambda name, off, part: cat.key(name, off, part)
+        ent_local = lambda k: k // P
+        uses_row = lambda p, i: key("USES",
+                                    ent_local(p) * L + i, p % P)
+        supp_row = lambda s, i: key("SUPPLIES",
+                                    ent_local(s) * L + i, s % P)
+
+        keys = np.full((Q, Rmax), np.int32(2**31 - 1), np.int64)
+        is_write = np.zeros((Q, Rmax), bool)
+        aux = np.zeros((Q, Rmax), np.int64)
+        n_req = np.zeros(Q, np.int64)
+
+        # vectorized where possible; chain walks per row (host-side gen)
+        for q in range(Q):
+            t = ttype[q]
+            pk, pr, sk = int(part_k[q]), int(product_k[q]), int(supplier_k[q])
+            acc = []
+            if t == PPS_GETPART:
+                acc = [(key("PARTS", ent_local(pk), pk % P), False, 0)]
+            elif t == PPS_GETPRODUCT:
+                acc = [(key("PRODUCTS", ent_local(pr), pr % P), False, 0)]
+            elif t == PPS_GETSUPPLIER:
+                acc = [(key("SUPPLIERS", ent_local(sk), sk % P), False, 0)]
+            elif t == PPS_GETPARTBYPRODUCT:
+                acc = [(key("PRODUCTS", ent_local(pr), pr % P), False, 0)]
+                for i, p in enumerate(uses[pr]):
+                    acc.append((uses_row(pr, i), False, 0))
+                    acc.append((key("PARTS", ent_local(int(p)), int(p) % P),
+                                False, 0))
+            elif t == PPS_GETPARTBYSUPPLIER:
+                acc = [(key("SUPPLIERS", ent_local(sk), sk % P), False, 0)]
+                for i, p in enumerate(supplies[sk]):
+                    acc.append((supp_row(sk, i), False, 0))
+                    acc.append((key("PARTS", ent_local(int(p)), int(p) % P),
+                                False, 0))
+            elif t == PPS_ORDERPRODUCT:
+                acc = [(key("PRODUCTS", ent_local(pr), pr % P), False, 0)]
+                for i, p in enumerate(uses[pr]):
+                    acc.append((uses_row(pr, i), False, 0))
+                    acc.append((key("PARTS", ent_local(int(p)), int(p) % P),
+                                True, ROLE_ORDER))
+            elif t == PPS_UPDATEPRODUCTPART:
+                # "always the first part for this product" (pps_txn.cpp:968)
+                acc = [(uses_row(pr, 0), True, ROLE_SETUSES | (pk << 3))]
+            elif t == PPS_UPDATEPART:
+                acc = [(key("PARTS", ent_local(pk), pk % P), True,
+                        ROLE_UPDPART)]
+            n_req[q] = len(acc)
+            for r, (k, w, a) in enumerate(acc):
+                keys[q, r] = k
+                is_write[q, r] = w
+                aux[q, r] = a
+
+        targs = np.zeros((Q, N_TARGS), np.int64)
+        targs[:, TA_PRODUCT] = product_k
+        targs[:, TA_PART] = part_k
+        targs[:, TA_SUPPLIER] = supplier_k
+
+        return QueryPool(
+            keys=keys.astype(np.int32),
+            is_write=is_write,
+            n_req=n_req.astype(np.int32),
+            home_part=home_part.astype(np.int32),
+            txn_type=ttype.astype(np.int32),
+            args=targs.astype(np.int32),
+            aux=aux.astype(np.int32),
+        )
+
+    def cc_rows(self, cfg: Config) -> int:
+        return catalog(cfg).rows_global
+
+    def init_tables(self, cfg: Config, part: int = 0) -> dict:
+        import jax.numpy as jnp
+        cat = catalog(cfg)
+        _, uses, _ = self._load(cfg)
+        P = cfg.part_cnt
+        L = cfg.max_parts_per
+        n_uses = cat.tables["USES"].n_local
+        # per-shard USES part-key column (only shard `part`'s products)
+        col = np.zeros(n_uses, np.int32)
+        for pr in range(1, cfg.max_product_key + 1):
+            if pr % P != part:
+                continue
+            base = (pr // P) * L
+            chain = uses[pr]
+            col[base:base + len(chain)] = chain
+        return {
+            "part_amount": jnp.full(cat.tables["PARTS"].n_local, 1000,
+                                    jnp.int32),
+            "uses_part": jnp.asarray(col),
+        }
+
+    def commit_fields(self, cfg: Config, tables: dict, txn, commit) -> dict:
+        import jax.numpy as jnp
+        role = jnp.where(commit[:, None], txn.aux & 7, 0)
+        earg = jnp.where(commit[:, None], txn.aux >> 3, 0)
+        return {"role": role.astype(jnp.int32), "earg": earg.astype(jnp.int32)}
+
+    def apply_commit_entries(self, cfg: Config, tables: dict, key_local,
+                             part, fields: dict, cts, live) -> dict:
+        import jax.numpy as jnp
+        from deneva_tpu.ops import segment as seg
+
+        cat = catalog(cfg)
+        t = dict(tables)
+        role = jnp.where(live, fields["role"] & 7, ROLE_NONE)
+        earg = fields["earg"]
+        OOB = jnp.int32(2**31 - 1)
+
+        def off(table, mask):
+            return jnp.where(mask, key_local - cat.tables[table].base, OOB)
+
+        # PART_AMOUNT: -1 per committed order line, +100 per updatepart
+        m_ord = role == ROLE_ORDER
+        m_upd = role == ROLE_UPDPART
+        t["part_amount"] = t["part_amount"].at[off("PARTS", m_ord)].add(
+            -1, mode="drop")
+        t["part_amount"] = t["part_amount"].at[off("PARTS", m_upd)].add(
+            100, mode="drop")
+
+        # USES part-key overwrite: last committer (max cts) per row wins
+        m_set = role == ROLE_SETUSES
+        skey = jnp.where(m_set, key_local, OOB)
+        n = key_local.shape[0]
+        idx = jnp.arange(n, dtype=jnp.int32)
+        (sk, _), (sidx,) = seg.sort_by((skey, cts), (idx,))
+        is_last = (jnp.roll(sk, -1) != sk).at[-1].set(True)
+        last = jnp.zeros(n, dtype=bool).at[sidx].set(is_last)
+        winner = m_set & last
+        t["uses_part"] = t["uses_part"].at[off("USES", winner)].set(
+            jnp.where(winner, earg, 0), mode="drop")
+        return t
+
+    def user_abort(self, cfg: Config, txn, finishing):
+        import jax.numpy as jnp
+        return jnp.zeros_like(finishing)

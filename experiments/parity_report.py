@@ -11,12 +11,16 @@ import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")
 
-from deneva_tpu.config import Config                      # noqa: E402
-from deneva_tpu.oracle.parity import run_pair             # noqa: E402
+from deneva_tpu.config import Config                              # noqa: E402
+from deneva_tpu.oracle.parity import run_pair, run_pair_sharded   # noqa: E402
 
 ALGS = ["NO_WAIT", "WAIT_DIE", "TIMESTAMP", "MVCC", "OCC", "MAAT", "CALVIN"]
 
@@ -66,6 +70,28 @@ def main():
                 f"| {'yes' if r['batched_conserved'] and r['sequential_conserved'] else 'NO'} |")
             print(label, alg, f"div={r['abort_rate_divergence']:.4f}")
         lines.append("")
+    # multi-shard parity: ShardedEngine on the virtual mesh vs the N-node
+    # sequential oracle (exercises routing, owner arbitration, 2PC votes)
+    lines += ["## multi-shard (zipf 0.6, 50/50 rw, mpr=1, ppt=2)", "",
+              "| CC_ALG | nodes | batched abort rate | sequential abort "
+              "rate | divergence | tput ratio | conserved |",
+              "|---|---|---|---|---|---|---|"]
+    for alg in ALGS:
+        for n in (2, 4, 8):
+            cfg = Config(cc_alg=alg, node_cnt=n, part_cnt=n, batch_size=64,
+                         synth_table_size=1 << 14, req_per_query=6,
+                         zipf_theta=0.6, query_pool_size=1 << 12, mpr=1.0,
+                         part_per_txn=2, warmup_ticks=0)
+            r = run_pair_sharded(cfg, n_ticks)
+            lines.append(
+                f"| {alg} | {n} | {r['batched']['abort_rate']:.4f} "
+                f"| {r['sequential']['abort_rate']:.4f} "
+                f"| {r['abort_rate_divergence']:.4f} "
+                f"| {r['tput_ratio']:.3f} "
+                f"| {'yes' if r['batched_conserved'] and r['sequential_conserved'] else 'NO'} |")
+            print("multi-shard", alg, n,
+                  f"div={r['abort_rate_divergence']:.4f}")
+    lines.append("")
     lines += [
         "Enforced continuously by `tests/test_parity.py` (thresholds with "
         "~1.5x noise headroom).  Remaining known divergence sources: "

@@ -35,6 +35,28 @@ def test_abort_rate_parity(alg):
     assert 0.8 <= r["tput_ratio"] <= 1.25, r
 
 
+SHARDED_THRESH = {
+    # measured (PARITY.md multi-shard section) x ~1.5 headroom; the N-node
+    # oracle replays the sharded tick protocol (access-before-commit phase
+    # order = locks held through 2PC, node-interleaved ts, per-node pools)
+    "NO_WAIT": 0.03, "WAIT_DIE": 0.02, "MAAT": 0.04, "CALVIN": 0.0,
+}
+
+
+@pytest.mark.parametrize("alg", list(SHARDED_THRESH))
+@pytest.mark.parametrize("nodes", [2, 8])
+def test_multi_shard_abort_rate_parity(alg, nodes):
+    from deneva_tpu.oracle.parity import run_pair_sharded
+    cfg = Config(cc_alg=alg, node_cnt=nodes, part_cnt=nodes, batch_size=64,
+                 synth_table_size=1 << 14, req_per_query=6, zipf_theta=0.6,
+                 query_pool_size=1 << 12, mpr=1.0, part_per_txn=2,
+                 warmup_ticks=0)
+    r = run_pair_sharded(cfg, 40)
+    assert r["batched_conserved"] and r["sequential_conserved"], r
+    assert r["abort_rate_divergence"] <= SHARDED_THRESH[alg], r
+    assert 0.85 <= r["tput_ratio"] <= 1.2, r
+
+
 def test_calvin_identical_commit_counts():
     r = run_pair(Config(cc_alg="CALVIN", **CFG), n_ticks=50)
     assert r["batched"]["total_txn_abort_cnt"] == 0
