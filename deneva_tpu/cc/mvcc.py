@@ -56,7 +56,7 @@ import jax.numpy as jnp
 
 from deneva_tpu.cc.base import AccessDecision, CCPlugin
 from deneva_tpu.config import Config
-from deneva_tpu.engine.state import TxnState, make_entries, NULL_KEY
+from deneva_tpu.engine.state import BIG_TS, NULL_KEY, TxnState, make_entries
 from deneva_tpu.ops import segment as seg
 
 
@@ -147,40 +147,46 @@ class Mvcc(CCPlugin):
 
     def on_commit(self, cfg: Config, db: dict, txn: TxnState, committed,
                   commit_ts, tick):
-        # insert the newest committed write per row into the min-ts slot;
-        # evicted and same-tick-shadowed version ts fold into w_floor
+        # insert EVERY committed write as a version, newest-first per row,
+        # one rank per while_loop round (several same-tick commits to one
+        # row each install a version in the reference too — folding all but
+        # the newest into the floor was measured as a systematic +4% abort
+        # bias at zipf 0.9, PARITY.md); a version older than everything
+        # retained still folds into w_floor
         B, R = txn.keys.shape
         n_rows, H = db["w_ring"].shape
-        n = B * R
         ridx = jnp.arange(R, dtype=jnp.int32)[None, :]
         wmask = (committed[:, None] & txn.is_write
                  & (ridx < txn.n_req[:, None])).reshape(-1)
         key = jnp.where(wmask, txn.keys.reshape(-1), NULL_KEY)
         ts = jnp.broadcast_to(txn.ts[:, None], (B, R)).reshape(-1)
 
-        (skey, sts), _ = seg.sort_by((key, ts), ())
-        live = skey != NULL_KEY
-        idx = jnp.arange(n)
-        is_end = jnp.where(idx == n - 1, True, skey != jnp.roll(skey, -1))
-        winner = live & is_end
-        shadowed = live & ~winner   # older same-tick writes to the same row
+        # newest-first within each row: sort by (key, BIG - ts)
+        (skey, _), (sts, slive) = seg.sort_by(
+            (key, BIG_TS - ts), (ts, wmask))
+        starts = seg.segment_starts(skey)
+        rank = seg.pos_in_segment(starts)
+        max_rank = jnp.max(jnp.where(slive, rank, 0))
 
-        kk = jnp.clip(skey, 0, n_rows - 1)
-        ring = db["w_ring"][kk]                     # (n, H)
-        slot = jnp.argmin(ring, axis=1).astype(jnp.int32)
-        evicted_ts = jnp.take_along_axis(ring, slot[:, None], axis=1)[:, 0]
+        def body(carry):
+            r, w_ring, r_ring, w_floor = carry
+            sel = slive & (rank == r)
+            kk = jnp.where(sel, skey, n_rows)
+            ring = w_ring[jnp.clip(kk, 0, n_rows - 1)]       # (n, H)
+            slot = jnp.argmin(ring, axis=1).astype(jnp.int32)
+            evicted_ts = jnp.take_along_axis(ring, slot[:, None],
+                                             axis=1)[:, 0]
+            insert_ok = sel & (sts > evicted_ts)
+            ik = jnp.where(insert_ok, kk, n_rows)
+            w_ring = w_ring.at[ik, slot].set(sts, mode="drop")
+            r_ring = r_ring.at[ik, slot].set(0, mode="drop")
+            w_floor = w_floor.at[jnp.where(sel, kk, n_rows)].max(
+                jnp.where(insert_ok, evicted_ts, sts), mode="drop")
+            return r + 1, w_ring, r_ring, w_floor
 
-        # a version older than everything retained goes straight to the
-        # floor (inserting it would evict a NEWER version); otherwise it
-        # replaces the ring minimum, which moves to the floor
-        insert_ok = winner & (sts > evicted_ts)
-        ik = jnp.where(insert_ok, kk, n_rows)
-        w_ring = db["w_ring"].at[ik, slot].set(sts, mode="drop")
-        r_ring = db["r_ring"].at[ik, slot].set(0, mode="drop")
-        w_floor = db["w_floor"].at[jnp.where(winner, kk, n_rows)].max(
-            jnp.where(insert_ok, evicted_ts, sts), mode="drop")
-        w_floor = w_floor.at[jnp.where(shadowed, kk, n_rows)].max(
-            sts, mode="drop")
+        _, w_ring, r_ring, w_floor = jax.lax.while_loop(
+            lambda c: c[0] <= max_rank, body,
+            (jnp.int32(0), db["w_ring"], db["r_ring"], db["w_floor"]))
         return {**db, "w_ring": w_ring, "r_ring": r_ring, "w_floor": w_floor}
 
 

@@ -43,6 +43,17 @@ class TwoPLPlugin(CCPlugin):
 
     def access(self, cfg: Config, db: dict, txn: TxnState, active):
         B, R = txn.keys.shape
+        from deneva_tpu.config import SERIALIZABLE
+        if cfg.sub_ticks > 1 and cfg.isolation_level in (SERIALIZABLE,
+                                                         READ_COMMITTED):
+            # finer time quantization for sequential-interleaving parity
+            # (Config.sub_ticks; SURVEY.md §7 within-batch ordering);
+            # NOLOCK / READ_UNCOMMITTED take their bypass paths below
+            assert cfg.acquire_window == 1, "sub_ticks needs window=1"
+            g, w, a = twopl.arbitrate_subticked(
+                txn, active, self.policy, cfg.sub_ticks,
+                read_locks_held=(cfg.isolation_level == SERIALIZABLE))
+            return AccessDecision(grant=g, wait=w, abort=a), db
         if self._window_path(cfg):
             g, w, a, tmp = twopl.arbitrate_window(
                 txn, active, self.policy, db, cfg.acquire_window,

@@ -284,3 +284,66 @@ def arbitrate_window(txn, active, policy: str, tmp: dict,
     tmp = {**tmp,
            "lk_held": lk_held.at[hrow.reshape(-1)].set(BIG_TS, mode="drop")}
     return to_BR(grantW), to_BR(waitW), to_BR(abortW), tmp
+
+
+# ---------------------------------------------------------------------------
+# Sub-ticked arbitration — finer time quantization for parity
+# ---------------------------------------------------------------------------
+
+def arbitrate_subticked(txn, active, policy: str, K: int,
+                        read_locks_held: bool = True):
+    """Arbitrate one tick's requests in K timestamp-ordered sub-rounds.
+
+    The one-round tick decides all requests against the tick-START lock
+    state: a txn aborted this tick still blocks its rows until next tick,
+    and a granted lock only takes effect for later requests through the
+    priority order.  A sequential interleaving instead sees every release
+    and grant IMMEDIATELY (the within-batch ordering effect flagged in
+    SURVEY.md §7).  Sub-ticking splits the batch into K contiguous ts
+    groups: group k arbitrates against the lock state left by groups < k
+    (grants added, aborted txns' locks removed).  K -> B converges to the
+    sequential reference's schedule; PARITY.md quantifies divergence vs K.
+
+    Requires acquire_window == 1 (one request per txn per tick, the
+    faithful state machine).  Returns (grant, wait, abort) (B, R) masks.
+    """
+    B, R = txn.keys.shape
+    ridx = jnp.arange(R, dtype=jnp.int32)[None, :]
+    cur = txn.cursor[:, None]
+    held_base = active[:, None] & (ridx < cur)
+    if not read_locks_held:
+        held_base = held_base & txn.is_write
+    req_base = active[:, None] & (ridx == cur) & (cur < txn.n_req[:, None])
+
+    # contiguous ts groups (ts unique among live txns)
+    tsk = jnp.where(active, txn.ts, BIG_TS)
+    order = jnp.argsort(tsk)
+    rank = jnp.zeros(B, jnp.int32).at[order].set(
+        jnp.arange(B, dtype=jnp.int32))
+    n_act = jnp.maximum(jnp.sum(active.astype(jnp.int32)), 1)
+    group = jnp.minimum(rank * K // n_act, K - 1)
+
+    G = jnp.zeros((B, R), dtype=bool)
+    W = jnp.zeros((B, R), dtype=bool)
+    A = jnp.zeros((B, R), dtype=bool)
+    dead = jnp.zeros(B, dtype=bool)
+
+    flat = lambda x: x.reshape(-1)
+    tse = jnp.broadcast_to(txn.ts[:, None], (B, R))
+    txe = jnp.broadcast_to(jnp.arange(B, dtype=jnp.int32)[:, None], (B, R))
+
+    for k in range(K):
+        grp = active & (group == k) & ~dead
+        held_m = (held_base | G) & ~dead[:, None]
+        req_m = req_base & grp[:, None]
+        live = held_m | req_m
+        ent = Entries(
+            key=flat(jnp.where(live, txn.keys, NULL_KEY)),
+            txn=flat(txe), ridx=flat(jnp.broadcast_to(ridx, (B, R))),
+            ts=flat(tse), is_write=flat(txn.is_write),
+            held=flat(held_m), req=flat(req_m))
+        g, w, a = arbitrate(ent, policy)
+        g, w, a = g.reshape(B, R), w.reshape(B, R), a.reshape(B, R)
+        G, W, A = G | g, W | w, A | a
+        dead = dead | a.any(axis=1)
+    return G, W, A

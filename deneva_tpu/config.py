@@ -38,6 +38,15 @@ READ_UNCOMMITTED = "READ_UNCOMMITTED"
 NOLOCK = "NOLOCK"
 ISOLATION_LEVELS = (SERIALIZABLE, READ_COMMITTED, READ_UNCOMMITTED, NOLOCK)
 
+# Debug/bottleneck-isolation mode ladder (reference config.h:314-319,
+# "NORMAL < NOCC < QRY_ONLY < SETUP < SIMPLE"; row.cpp:199-206 gates).
+# Each mode strips one more layer, isolating where time/aborts go:
+MODE_NORMAL = "NORMAL"       # full CC
+MODE_NOCC = "NOCC"           # CC disabled: every access grants (row.cpp:199)
+MODE_QRY_ONLY = "QRY_ONLY"   # NOCC + no row writes applied
+MODE_SIMPLE = "SIMPLE"       # ack immediately: commit without executing
+MODES = (MODE_NORMAL, MODE_NOCC, MODE_QRY_ONLY, MODE_SIMPLE)
+
 
 @dataclasses.dataclass(frozen=True)
 class Config:
@@ -56,6 +65,7 @@ class Config:
     workload: str = YCSB
     cc_alg: str = NO_WAIT
     isolation_level: str = SERIALIZABLE
+    mode: str = MODE_NORMAL      # debug ladder (config.h:314-319)
 
     # --- scheduler / batch engine (replaces MAX_TXN_IN_FLIGHT + worker loop) ---
     batch_size: int = 4096       # concurrent in-flight txns per node (B)
@@ -70,13 +80,26 @@ class Config:
     #: are dropped, and T/O read-timestamp bumps from dropped reads persist).
     acquire_window: int = 1
 
-    #: max fresh admissions per tick (None = batch_size).  TPU-motivated:
-    #: admission's pool fetch is a row gather costing ~linear in rows
-    #: fetched; steady-state admissions/tick ~= commits/tick << B, so a cap
-    #: of B/8 shrinks the fetch 8x with no steady-state effect (ramp-up
-    #: takes a few extra ticks).  The reference has no analog (clients
-    #: issue queries one by one); parity runs leave this None.
+    #: max fresh admissions per tick (None = batch_size).
+    #: Doubles as the CLIENT LOAD MODEL: None reproduces LOAD_MAX (admit
+    #: whenever the inflight window has room, client_thread.cpp:70-80) and
+    #: a value reproduces LOAD_RATE (fixed-interval issue at cap txns/tick,
+    #: client_thread.cpp:81-91) — under saturation it is also a beneficial
+    #: concurrency throttle (PROFILE.md).  TPU-motivated besides: the pool
+    #: fetch is a row gather costing ~linear in rows fetched, so capping at
+    #: ~B/8 shrinks it 8x with no steady-state effect.  Parity runs leave
+    #: this None (the oracle admits into every free slot).
     admit_cap: Optional[int] = None
+
+    #: 2PL time-quantization refinement (SURVEY.md §7 "within-batch
+    #: ordering effects"): arbitrate each tick's lock requests in this many
+    #: timestamp-ordered sub-rounds, so aborts/grants from earlier
+    #: sub-rounds are visible to later ones — exactly the incremental lock
+    #: state a sequential interleaving sees.  1 = one synchronous round
+    #: (fastest); larger values converge to the sequential reference
+    #: (PARITY.md measures divergence vs K).  Requires acquire_window=1;
+    #: NO_WAIT/WAIT_DIE only.
+    sub_ticks: int = 1
 
     #: lock arbitration kernel.  False (default) = the sorted-segment join:
     #: one bitonic sort of all B*R live entries + prefix reductions, never
@@ -143,6 +166,17 @@ class Config:
     ts_twr: bool = False              # TS_TWR Thomas write rule (config.h:123)
     his_recycle_len: int = 8          # HIS_RECYCLE_LEN: MVCC version-ring slots
 
+    # --- logging / replication (reference config.h:147 LOGGING,
+    # :24-27 REPLICA_CNT; system/logger.cpp, worker_thread.cpp:527-554) ---
+    logging: bool = False        # command log gating commit (off by default,
+                                 # like the reference)
+    log_flush_ticks: int = 1     # commit waits this many ticks for the
+                                 # LOG_FLUSHED ack (LogThread flush latency)
+    repl_cnt: int = 0            # 0 or 1: replicate the command log to the
+                                 # next shard (LOG_MSG / LOG_MSG_RSP analog;
+                                 # sharded engine only)
+    log_buf_cap: int = 1 << 16   # command-log ring slots per shard
+
     # --- Calvin (reference config.h:348 SEQ_BATCH_TIMER) ---
     seq_batch_size: Optional[int] = None  # txns per epoch (None -> batch_size)
 
@@ -157,6 +191,7 @@ class Config:
         assert self.cc_alg in CC_ALGS, self.cc_alg
         assert self.workload in WORKLOADS, self.workload
         assert self.isolation_level in ISOLATION_LEVELS
+        assert self.mode in MODES, self.mode
         assert self.part_cnt >= self.node_cnt and self.part_cnt % self.node_cnt == 0
         assert self.synth_table_size % self.part_cnt == 0
         # row ids must fit 30 bits: lock arbitration packs (row_id, kind)

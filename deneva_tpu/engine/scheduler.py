@@ -80,11 +80,22 @@ STAT_KEYS_F32 = (
 LAT_SAMPLES = 1 << 14
 
 
-def _zeros_stats() -> dict:
+def _zeros_stats(cfg: Config | None = None) -> dict:
     s = {k: jnp.zeros((), jnp.int32) for k in STAT_KEYS_I32}
     s.update({k: jnp.zeros((), jnp.float32) for k in STAT_KEYS_F32})
     s["arr_lat_short"] = jnp.zeros(LAT_SAMPLES, jnp.int32)
     s["lat_ring_cursor"] = jnp.zeros((), jnp.int32)
+    if cfg is not None and cfg.logging:
+        # command-log ring (Logger's log_file ring, system/logger.cpp:60-117:
+        # one L_UPDATE record per committed write: lsn/txn_id/key)
+        s["arr_log_key"] = jnp.zeros(cfg.log_buf_cap, jnp.int32)
+        s["arr_log_tid"] = jnp.zeros(cfg.log_buf_cap, jnp.int32)
+        s["log_lsn"] = jnp.zeros((), jnp.int32)
+        if cfg.repl_cnt > 0:
+            # replica's copy of its predecessor shard's command log
+            # (process_log_msg, worker_thread.cpp:527-533)
+            s["arr_repl_key"] = jnp.zeros(cfg.log_buf_cap, jnp.int32)
+            s["repl_lsn"] = jnp.zeros((), jnp.int32)
     return s
 
 
@@ -147,14 +158,105 @@ def pool_admit(pool_dev: dict, txn: TxnState, admit, frank, pool_cursor,
     return keys, is_write, n_req, txn_type, targs, aux, pool_idx
 
 
+def bump(stats: dict, key: str, amount, measuring) -> dict:
+    """Warmup-gated counter increment (INC_STATS + is_warmup_done,
+    system/helper.h:136-150)."""
+    inc = jnp.where(measuring, amount, 0).astype(stats[key].dtype)
+    return {**stats, key: stats[key] + inc}
+
+
+def record_commit_latency(stats: dict, commit, t, start_tick,
+                          measuring) -> dict:
+    """Append committing txns' short latencies to the sampling ring
+    (StatsArr, statistics/stats_array.cpp).  Shared by both engines."""
+    crank = jnp.cumsum(commit.astype(jnp.int32)) - commit.astype(jnp.int32)
+    rec = commit & measuring
+    pos = jnp.where(rec, (stats["lat_ring_cursor"] + crank) % LAT_SAMPLES,
+                    LAT_SAMPLES)
+    n_commit = jnp.sum(commit.astype(jnp.int32))
+    return {**stats,
+            "arr_lat_short": stats["arr_lat_short"].at[pos].set(
+                t - start_tick, mode="drop"),
+            "lat_ring_cursor": stats["lat_ring_cursor"]
+            + jnp.where(measuring, n_commit, 0)}
+
+
+def track_parts_touched(stats: dict, txn: TxnState, commit, n_parts: int,
+                        measuring) -> dict:
+    """Distinct-partition counters per commit (partitions_touched,
+    system/query.h) via a popcounted bitmask.  Shared by both engines."""
+    ridx = jnp.arange(txn.R, dtype=jnp.int32)[None, :]
+    n_commit = jnp.sum(commit.astype(jnp.int32))
+    if n_parts > 1 and n_parts <= 31:
+        amask = ridx < txn.n_req[:, None]
+        bits = jnp.where(amask, jnp.int32(1) << (txn.keys % n_parts), 0)
+        pbits = jnp.zeros(txn.B, jnp.int32)
+        for r in range(txn.R):
+            pbits = pbits | bits[:, r]
+        npart = jax.lax.population_count(pbits)
+        stats = bump(stats, "parts_touched",
+                     jnp.sum(jnp.where(commit, npart, 0)), measuring)
+        stats = bump(stats, "multi_part_txn_cnt",
+                     jnp.sum((commit & (npart > 1)).astype(jnp.int32)),
+                     measuring)
+    else:
+        stats = bump(stats, "parts_touched", n_commit, measuring)
+    return stats
+
+
+def append_log_ring(stats: dict, cfg: Config, wflat, keys_flat,
+                    tid_flat) -> dict:
+    """One L_UPDATE record per committed write into the device log ring
+    (logger.cpp:20-34).  Shared by both engines."""
+    lrank = jnp.cumsum(wflat.astype(jnp.int32)) - wflat.astype(jnp.int32)
+    lpos = jnp.where(wflat, (stats["log_lsn"] + lrank) % cfg.log_buf_cap,
+                     cfg.log_buf_cap)
+    return {**stats,
+            "arr_log_key": stats["arr_log_key"].at[lpos].set(
+                keys_flat, mode="drop"),
+            "arr_log_tid": stats["arr_log_tid"].at[lpos].set(
+                tid_flat, mode="drop"),
+            "log_lsn": stats["log_lsn"]
+            + jnp.sum(wflat.astype(jnp.int32))}
+
+
+def track_state_latencies(stats: dict, txn: TxnState, measuring) -> dict:
+    """End-of-tick latency decomposition integrals (the lat_* families of
+    stats.cpp:992-999).  Shared by both engines."""
+    for key, st_v in (("lat_process_time", STATUS_RUNNING),
+                      ("lat_cc_block_time", STATUS_WAITING),
+                      ("lat_abort_time", STATUS_BACKOFF)):
+        stats = bump(stats, key,
+                     jnp.sum((txn.status == st_v).astype(jnp.int32)),
+                     measuring)
+    return stats
+
+
+def recon_defer(stats: dict, workload, txn_type, free, status,
+                backoff_until, t, measuring):
+    """Calvin reconnaissance deferral (sequencer.cpp:88-114): recon-typed
+    admissions sleep one epoch.  Returns (status, backoff_until, stats)."""
+    is_recon = jnp.zeros_like(free)
+    for tt in workload.recon_types:
+        is_recon = is_recon | (txn_type == tt)
+    is_recon = free & is_recon
+    status = jnp.where(is_recon, STATUS_BACKOFF, status)
+    backoff_until = jnp.where(is_recon, t + 1, backoff_until)
+    stats = bump(stats, "recon_cnt",
+                 jnp.sum(is_recon.astype(jnp.int32)), measuring)
+    return status, backoff_until, stats
+
+
 def make_tick(cfg: Config, plugin, pool_dev: dict, workload=None):
     Q = pool_dev["kw"].shape[0]
     if workload is None:
         workload = wl_registry.get(cfg)
-
-    def bump(stats, key, amount, measuring):
-        inc = jnp.where(measuring, amount, 0).astype(stats[key].dtype)
-        return {**stats, key: stats[key] + inc}
+    from deneva_tpu.config import MODE_NOCC, MODE_NORMAL, MODE_SIMPLE
+    # debug mode ladder (config.h:314-319): NOCC grants every access
+    # (row.cpp:199-206), QRY_ONLY additionally applies no writes, SIMPLE
+    # commits at admission without executing
+    normal = cfg.mode == MODE_NORMAL
+    apply_writes = cfg.mode in (MODE_NORMAL, MODE_NOCC)
 
     def tick_fn(state: EngineState) -> EngineState:
         txn, db, data, stats = state.txn, state.db, state.data, state.stats
@@ -170,13 +272,19 @@ def make_tick(cfg: Config, plugin, pool_dev: dict, workload=None):
         # ---- 2. admission from query pool ----
         free = status == STATUS_FREE
         cap = cfg.admit_cap if cfg.admit_cap is not None else cfg.batch_size
-        if plugin.epoch_admission:
-            # sequencer batch release: at most epoch_size fresh txns per
-            # tick (SEQ_BATCH_TIMER analog, system/sequencer.cpp:283-326)
-            cap = min(cap, cfg.epoch_size)
-        cap = min(cap, cfg.batch_size, Q)
         frank = jnp.cumsum(free.astype(jnp.int32)) - free.astype(jnp.int32)
-        free = free & (frank < cap)
+        gate = frank
+        if plugin.epoch_admission:
+            # sequencer batch release: at most epoch_size txns per epoch
+            # (SEQ_BATCH_TIMER analog, system/sequencer.cpp:283-326);
+            # resumed recon txns consume this epoch's slots too (the
+            # re-submitted txn joins a later batch, sequencer.cpp:88-114).
+            # Only the CAP comparison is offset — frank itself stays the
+            # admitted rank, which pool_admit maps onto pool rows.
+            cap = min(cap, cfg.epoch_size)
+            gate = gate + jnp.sum(expire.astype(jnp.int32))
+        cap = min(cap, cfg.batch_size, Q)
+        free = free & (gate < cap)
         n_free = jnp.sum(free.astype(jnp.int32))
 
         keys, is_write, n_req, txn_type, targs, aux, pool_idx = pool_admit(
@@ -191,7 +299,8 @@ def make_tick(cfg: Config, plugin, pool_dev: dict, workload=None):
         ts_counter = state.ts_counter + jnp.sum(need_ts.astype(jnp.int32))
 
         status = jnp.where(free, STATUS_RUNNING, status)
-        cursor = jnp.where(free, 0, txn.cursor)
+        cursor = jnp.where(free, n_req if cfg.mode == MODE_SIMPLE else 0,
+                           txn.cursor)
         restarts = jnp.where(free, 0, txn.restarts)
         start_tick = jnp.where(free, t, start_tick)
         first_start_tick = jnp.where(free, t, txn.first_start_tick)
@@ -199,45 +308,55 @@ def make_tick(cfg: Config, plugin, pool_dev: dict, workload=None):
 
         backoff_until = txn.backoff_until
         if plugin.epoch_admission and workload.recon_types:
-            # Calvin reconnaissance pass (sequencer.cpp:88-114): recon-typed
-            # txns spend one epoch discovering their read/write set before
-            # sequencing — modeled as a one-tick admission deferral
-            is_recon = jnp.zeros_like(free)
-            for tt in workload.recon_types:
-                is_recon = is_recon | (txn_type == tt)
-            is_recon = free & is_recon
-            status = jnp.where(is_recon, STATUS_BACKOFF, status)
-            backoff_until = jnp.where(is_recon, t + 1, backoff_until)
-            stats = bump(stats, "recon_cnt",
-                         jnp.sum(is_recon.astype(jnp.int32)), measuring)
+            status, backoff_until, stats = recon_defer(
+                stats, workload, txn_type, free, status, backoff_until, t,
+                measuring)
 
         txn = TxnState(status=status, cursor=cursor, ts=ts, pool_idx=pool_idx,
                        restarts=restarts, backoff_until=backoff_until,
                        start_tick=start_tick, first_start_tick=first_start_tick,
                        keys=keys, is_write=is_write, n_req=n_req,
                        txn_type=txn_type, targs=targs, aux=aux)
-        db = plugin.on_start(cfg, db, txn, free | expire)
+        if normal:
+            db = plugin.on_start(cfg, db, txn, free | expire)
 
         # ---- 3. commit phase ----
         finishing = (txn.status == STATUS_RUNNING) & (txn.cursor >= txn.n_req)
+        if cfg.logging:
+            # commit blocks until the LOG_FLUSHED ack (worker_thread.cpp:
+            # 535-554): the access phase stamps backoff_until with the
+            # flush-ready tick when the last access grants
+            finishing = finishing & (txn.backoff_until <= t)
         # workload rollback (TPC-C rbk at TPCC_FIN, tpcc_txn.cpp:485-489):
         # releases CC state like an abort but frees the slot, no effects
         ua = workload.user_abort(cfg, txn, finishing)
         finishing = finishing & ~ua
-        ok, db = plugin.validate(cfg, db, txn, finishing, t)
+        if normal:
+            ok, db = plugin.validate(cfg, db, txn, finishing, t)
+        else:
+            ok = finishing
         commit = finishing & ok
         vabort = finishing & ~ok
-        db = plugin.on_commit(cfg, db, txn, commit, commit_ts=txn.ts, tick=t)
+        if normal:
+            db = plugin.on_commit(cfg, db, txn, commit, commit_ts=txn.ts,
+                                  tick=t)
 
         ridx = jnp.arange(txn.R, dtype=jnp.int32)[None, :]
         wmask = commit[:, None] & txn.is_write & (ridx < txn.n_req[:, None])
-        # dead lanes scatter to an out-of-bounds index and are dropped
-        # (adding 0 at a real key would still serialize on hot rows)
-        data = data.at[jnp.where(wmask, txn.keys,
-                                 jnp.int32(2**31 - 1)).reshape(-1)].add(
-            1, mode="drop")
+        if apply_writes:
+            # dead lanes scatter to an out-of-bounds index and are dropped
+            # (adding 0 at a real key would still serialize on hot rows)
+            data = data.at[jnp.where(wmask, txn.keys,
+                                     jnp.int32(2**31 - 1)).reshape(-1)].add(
+                1, mode="drop")
 
-        if workload.has_effects:
+        if cfg.logging:
+            tid_e = jnp.broadcast_to(txn.pool_idx[:, None],
+                                     (txn.B, txn.R)).reshape(-1)
+            stats = append_log_ring(stats, cfg, wmask.reshape(-1),
+                                    txn.keys.reshape(-1), tid_e)
+
+        if workload.has_effects and apply_writes:
             # single-shard: catalog keys are shard-local (part_cnt == 1).
             # Within-tick effect order follows the COMMIT timestamp (MaaT's
             # find_bound lower), matching the sharded engine's exchange B.
@@ -258,34 +377,10 @@ def make_tick(cfg: Config, plugin, pool_dev: dict, workload=None):
         stats = bump(stats, "vabort_cnt",
                      jnp.sum(vabort.astype(jnp.int32)), measuring)
 
-        # partitions touched per commit (BaseQuery::partitions_touched,
-        # system/query.h): distinct parts as a popcounted bitmask
-        if cfg.part_cnt > 1 and cfg.part_cnt <= 31:
-            amask = (ridx < txn.n_req[:, None])
-            bits = jnp.where(amask, jnp.int32(1) << (txn.keys % cfg.part_cnt),
-                             0)
-            pbits = jnp.zeros(txn.B, jnp.int32)
-            for r in range(txn.R):
-                pbits = pbits | bits[:, r]
-            npart = jax.lax.population_count(pbits)
-            stats = bump(stats, "parts_touched",
-                         jnp.sum(jnp.where(commit, npart, 0)), measuring)
-            stats = bump(stats, "multi_part_txn_cnt",
-                         jnp.sum((commit & (npart > 1)).astype(jnp.int32)),
-                         measuring)
-        else:
-            stats = bump(stats, "parts_touched", n_commit, measuring)
-
-        # commit-latency sampling ring (StatsArr analog)
-        crank = jnp.cumsum(commit.astype(jnp.int32)) - commit.astype(jnp.int32)
-        rec = commit & measuring
-        pos = jnp.where(rec, (stats["lat_ring_cursor"] + crank) % LAT_SAMPLES,
-                        LAT_SAMPLES)
-        stats = {**stats,
-                 "arr_lat_short": stats["arr_lat_short"].at[pos].set(
-                     t - txn.start_tick, mode="drop"),
-                 "lat_ring_cursor": stats["lat_ring_cursor"]
-                 + jnp.where(measuring, n_commit, 0)}
+        stats = track_parts_touched(stats, txn, commit, cfg.part_cnt,
+                                    measuring)
+        stats = record_commit_latency(stats, commit, t, txn.start_tick,
+                                      measuring)
         stats = bump(stats, "unique_txn_abort_cnt",
                      jnp.sum((commit & (txn.restarts > 0)).astype(jnp.int32)),
                      measuring)
@@ -304,7 +399,16 @@ def make_tick(cfg: Config, plugin, pool_dev: dict, workload=None):
         active = ((txn.status == STATUS_RUNNING) | (txn.status == STATUS_WAITING)) \
             & ~vabort
         has_req = active & (txn.cursor < txn.n_req)
-        dec, db = plugin.access(cfg, db, txn, active)
+        if normal:
+            dec, db = plugin.access(cfg, db, txn, active)
+        else:
+            from deneva_tpu.cc.base import AccessDecision
+            ridx_m = jnp.arange(txn.R, dtype=jnp.int32)[None, :]
+            reqm = (active[:, None] & (ridx_m >= txn.cursor[:, None])
+                    & (ridx_m < txn.cursor[:, None] + cfg.acquire_window)
+                    & (ridx_m < txn.n_req[:, None]))
+            z = jnp.zeros_like(reqm)
+            dec = AccessDecision(grant=reqm, wait=z, abort=z)
 
         # advance each txn over the granted prefix of its access program;
         # the wait/abort outcome is whatever the first non-granted requested
@@ -341,22 +445,27 @@ def make_tick(cfg: Config, plugin, pool_dev: dict, workload=None):
             cfg.abort_penalty_ticks).astype(jnp.int32)
         status = jnp.where(abort_now, STATUS_BACKOFF, status)
         cursor = jnp.where(abort_now, 0, cursor)
-        backoff_until = jnp.where(abort_now, t + penalty, txn.backoff_until)
+        backoff_base = txn.backoff_until
+        if cfg.logging:
+            # L_NOTIFY at finish + flush latency: stamp the tick at which
+            # the commit may proceed (the LogThread flush + LOG_FLUSHED
+            # round trip, logger.cpp:157-172); the commit-phase gate above
+            # reads this.  Normal commit happens at t+1, so flush_ticks=1
+            # costs exactly one extra tick.
+            reached = has_req & ~abort_now \
+                & (new_cursor >= txn.n_req) & (txn.cursor < txn.n_req)
+            backoff_base = jnp.where(reached,
+                                     t + 1 + cfg.log_flush_ticks,
+                                     backoff_base)
+        backoff_until = jnp.where(abort_now, t + penalty, backoff_base)
         restarts2 = jnp.where(abort_now, txn.restarts + 1, txn.restarts)
         txn = txn._replace(status=status, cursor=cursor,
                            backoff_until=backoff_until, restarts=restarts2)
-        db = plugin.on_abort(cfg, db, txn, abort_now | ua)
+        if normal:
+            db = plugin.on_abort(cfg, db, txn, abort_now | ua)
 
         # latency decomposition integrals: txn-ticks per end-of-tick state
-        stats = bump(stats, "lat_process_time",
-                     jnp.sum((txn.status == STATUS_RUNNING).astype(jnp.int32)),
-                     measuring)
-        stats = bump(stats, "lat_cc_block_time",
-                     jnp.sum((txn.status == STATUS_WAITING).astype(jnp.int32)),
-                     measuring)
-        stats = bump(stats, "lat_abort_time",
-                     jnp.sum((txn.status == STATUS_BACKOFF).astype(jnp.int32)),
-                     measuring)
+        stats = track_state_latencies(stats, txn, measuring)
 
         # ts wraparound guard: only relative order matters, and every live
         # txn's ts lies within [ts_counter - horizon, ts_counter], so rebase
@@ -411,7 +520,7 @@ class Engine:
             db=self.plugin.init_db(cfg, self.n_rows, B, R),
             data=jnp.zeros(self.n_rows, jnp.int32),
             tables=self.workload.init_tables(cfg, 0),
-            stats=_zeros_stats(),
+            stats=_zeros_stats(cfg),
             tick=jnp.zeros((), jnp.int32),
             pool_cursor=jnp.zeros((), jnp.int32),
             ts_counter=jnp.ones((), jnp.int32),

@@ -1,0 +1,93 @@
+"""Native runtime components (C++, built on demand with g++).
+
+The reference's runtime around the compute path is native C++ (logger,
+transport, allocator); the TPU rebuild keeps the compute path in XLA and
+implements the host-side IO natively too.  Current components:
+
+- ``logio``: durable command-log writer/reader (system/logger.cpp analog)
+  driven through ctypes — see logio.cpp.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+_DIR = os.path.dirname(__file__)
+_SO = os.path.join(_DIR, "_build", "liblogio.so")
+_SRC = os.path.join(_DIR, "logio.cpp")
+
+_lib = None
+
+
+def _build() -> str:
+    os.makedirs(os.path.dirname(_SO), exist_ok=True)
+    if (not os.path.exists(_SO)
+            or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-o", _SO, _SRC],
+            check=True, capture_output=True, text=True)
+    return _SO
+
+
+def lib() -> ctypes.CDLL:
+    global _lib
+    if _lib is None:
+        _lib = ctypes.CDLL(_build())
+        _lib.log_append.restype = ctypes.c_longlong
+        _lib.log_append.argtypes = [
+            ctypes.c_char_p,
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            ctypes.c_longlong, ctypes.c_longlong]
+        _lib.log_replay.restype = ctypes.c_longlong
+        _lib.log_replay.argtypes = [
+            ctypes.c_char_p,
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            ctypes.c_longlong]
+    return _lib
+
+
+def log_append(path: str, keys: np.ndarray, tids: np.ndarray,
+               start_lsn: int) -> int:
+    """Append records to the binary log; returns the count written."""
+    keys = np.ascontiguousarray(keys, np.int32)
+    tids = np.ascontiguousarray(tids, np.int32)
+    assert keys.shape == tids.shape
+    n = lib().log_append(path.encode(), keys, tids, keys.shape[0],
+                         start_lsn)
+    if n < 0:
+        raise IOError(f"log_append failed: {n}")
+    return int(n)
+
+
+def log_replay(path: str, n_rows: int) -> np.ndarray:
+    """Replay the log into per-row increment counts; raises on corruption
+    (torn record, bad checksum, lsn gap, key out of range)."""
+    counts = np.zeros(n_rows, np.int32)
+    n = lib().log_replay(path.encode(), counts, n_rows)
+    if n < 0:
+        raise IOError(f"log_replay failed: code {n}")
+    return counts
+
+
+def flush_engine_log(state, path: str, flushed_lsn: int = 0) -> int:
+    """Durably append the engine's device log ring past `flushed_lsn`.
+
+    Returns the new flushed lsn.  The ring holds the most recent
+    cfg.log_buf_cap records; callers must flush at least every
+    cap-records' worth of commits (asserted)."""
+    lsn = int(np.asarray(state.stats["log_lsn"]))
+    cap = state.stats["arr_log_key"].shape[0]
+    pending = lsn - flushed_lsn
+    assert 0 <= pending <= cap, "log ring overwrote unflushed records"
+    if pending == 0:
+        return lsn
+    keys = np.asarray(state.stats["arr_log_key"])
+    tids = np.asarray(state.stats["arr_log_tid"])
+    idx = (np.arange(flushed_lsn, lsn)) % cap
+    log_append(path, keys[idx], tids[idx], flushed_lsn)
+    return lsn

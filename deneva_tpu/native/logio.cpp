@@ -1,0 +1,118 @@
+// Native command-log file IO — the rebuild of the reference Logger's
+// binary record writer/reader (system/logger.cpp: enqueueRecord writes
+// checksum/lsn/type/iud/txn_id/table_id/key via WRITE_VAL, flushBuffer
+// syncs; LogThread drains the queue).
+//
+// The device engine keeps the command log as an HBM ring
+// (engine/scheduler.py arr_log_*); this module gives it the durable half:
+// the host pulls the ring and appends fixed-size checksummed records, and
+// recovery replays the file into per-row increment counts — which must
+// reproduce the engine's data array exactly (tests/test_native_logio.py).
+//
+// Built on demand with g++ into a shared library and driven through
+// ctypes (deneva_tpu/native/__init__.py); no Python objects cross the
+// boundary, only flat int32 buffers.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+
+namespace {
+
+struct Record {           // the WRITE_VAL field sequence, fixed width
+  uint32_t checksum;      // over the payload below
+  int64_t lsn;
+  int32_t iud;            // L_UPDATE == 1 (reference LogIUD)
+  int64_t txn_id;
+  int64_t key;
+};
+
+uint32_t fnv1a(const uint8_t *p, size_t n) {
+  uint32_t h = 2166136261u;
+  for (size_t i = 0; i < n; i++) {
+    h ^= p[i];
+    h *= 16777619u;
+  }
+  return h;
+}
+
+uint32_t record_checksum(const Record &r) {
+  Record c;
+  memset(&c, 0, sizeof(Record));  // struct copy need not preserve padding
+  c.lsn = r.lsn;
+  c.iud = r.iud;
+  c.txn_id = r.txn_id;
+  c.key = r.key;
+  return fnv1a(reinterpret_cast<const uint8_t *>(&c), sizeof(Record));
+}
+
+}  // namespace
+
+extern "C" {
+
+// Append n records; returns n on success, -1 on IO error.
+long long log_append(const char *path, const int32_t *keys,
+                     const int32_t *tids, long long n, long long start_lsn) {
+  FILE *f = fopen(path, "ab");
+  if (!f) return -1;
+  for (long long i = 0; i < n; i++) {
+    Record r;
+    memset(&r, 0, sizeof(Record));  // zero alignment padding: it is
+                                    // checksummed and written to disk
+    r.lsn = start_lsn + i;
+    r.iud = 1;  // L_UPDATE
+    r.txn_id = tids[i];
+    r.key = keys[i];
+    r.checksum = record_checksum(r);
+    if (fwrite(&r, sizeof(Record), 1, f) != 1) {
+      fclose(f);
+      return -1;
+    }
+  }
+  if (fflush(f) != 0) {   // Logger::flushBuffer (logger.cpp:157-172)
+    fclose(f);
+    return -1;
+  }
+  fclose(f);
+  return n;
+}
+
+// Replay the log into per-row increment counts (REDO of the YCSB command
+// log); verifies every checksum and lsn contiguity.
+// Returns the number of records replayed, or:
+//   -1 IO error   -2 torn/short record   -3 checksum mismatch
+//   -4 lsn discontinuity   -5 key out of range
+long long log_replay(const char *path, int32_t *counts, long long n_rows) {
+  FILE *f = fopen(path, "rb");
+  if (!f) return -1;
+  Record r;
+  long long n = 0;
+  int64_t expect_lsn = -1;
+  while (true) {
+    size_t got = fread(&r, 1, sizeof(Record), f);
+    if (got == 0) break;
+    if (got != sizeof(Record)) {
+      fclose(f);
+      return -2;
+    }
+    if (record_checksum(r) != r.checksum) {
+      fclose(f);
+      return -3;
+    }
+    if (expect_lsn >= 0 && r.lsn != expect_lsn) {
+      fclose(f);
+      return -4;
+    }
+    expect_lsn = r.lsn + 1;
+    if (r.key < 0 || r.key >= n_rows) {
+      fclose(f);
+      return -5;
+    }
+    counts[r.key] += 1;
+    n++;
+  }
+  fclose(f);
+  return n;
+}
+
+}  // extern "C"

@@ -36,8 +36,12 @@ def _pair_dict(cfg: Config, b: dict, b_data_sum: int, seq) -> dict:
 
 
 def run_pair(cfg: Config, n_ticks: int) -> dict:
-    """Run both engines on one shared pool; return their stats + divergence."""
-    pool = ycsb.gen_query_pool(cfg)
+    """Run both engines on one shared pool; return their stats + divergence.
+
+    Workload-agnostic: the oracle replays any QueryPool's (keys, is_write)
+    footprints, so TPC-C / PPS parity cells come for free."""
+    from deneva_tpu import workloads as wl_registry
+    pool = wl_registry.get(cfg).gen_pool(cfg)
 
     eng = Engine(cfg, pool=pool)
     st = eng.run(n_ticks)

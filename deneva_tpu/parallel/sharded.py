@@ -53,7 +53,11 @@ from deneva_tpu import cc as cc_registry
 from deneva_tpu import workloads as wl_registry
 from deneva_tpu.config import Config, TPCC
 from deneva_tpu.engine.scheduler import (STAT_KEYS_F32, STAT_KEYS_I32,
-                                         _zeros_stats)
+                                         _zeros_stats, append_log_ring,
+                                         bump, recon_defer,
+                                         record_commit_latency,
+                                         track_parts_touched,
+                                         track_state_latencies)
 from deneva_tpu.engine.state import (BIG_TS, NULL_KEY, STATUS_BACKOFF,
                                      STATUS_FREE, STATUS_RUNNING,
                                      STATUS_WAITING, TxnState)
@@ -91,10 +95,6 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
     if workload is None:
         workload = wl_registry.get(cfg)
 
-    def bump(stats, key, amount, measuring):
-        inc = jnp.where(measuring, amount, 0).astype(stats[key].dtype)
-        return {**stats, key: stats[key] + inc}
-
     def tick_fn(state: ShardState, node_id) -> ShardState:
         txn, db, data, stats = state.txn, state.db, state.data, state.stats
         tables = state.tables
@@ -108,12 +108,16 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
 
         free = status == STATUS_FREE
         acap = cfg.admit_cap if cfg.admit_cap is not None else cfg.batch_size
-        if plugin.epoch_admission:
-            # sequencer batch release (SEQ_BATCH_TIMER, sequencer.cpp:283-326)
-            acap = min(acap, cfg.epoch_size)
-        acap = min(acap, cfg.batch_size, Q)
         frank = jnp.cumsum(free.astype(jnp.int32)) - free.astype(jnp.int32)
-        free = free & (frank < acap)
+        gate = frank
+        if plugin.epoch_admission:
+            # sequencer batch release (SEQ_BATCH_TIMER, sequencer.cpp:
+            # 283-326); resumed recon txns count against the epoch too;
+            # only the cap comparison is offset (frank maps pool rows)
+            acap = min(acap, cfg.epoch_size)
+            gate = gate + jnp.sum(expire.astype(jnp.int32))
+        acap = min(acap, cfg.batch_size, Q)
+        free = free & (gate < acap)
         n_free = jnp.sum(free.astype(jnp.int32))
 
         from deneva_tpu.engine.scheduler import pool_admit
@@ -138,15 +142,9 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
 
         backoff_until = txn.backoff_until
         if plugin.epoch_admission and workload.recon_types:
-            # Calvin recon pass (sequencer.cpp:88-114): one-epoch deferral
-            is_recon = jnp.zeros_like(free)
-            for tt in workload.recon_types:
-                is_recon = is_recon | (txn_type == tt)
-            is_recon = free & is_recon
-            status = jnp.where(is_recon, STATUS_BACKOFF, status)
-            backoff_until = jnp.where(is_recon, t + 1, backoff_until)
-            stats = bump(stats, "recon_cnt",
-                         jnp.sum(is_recon.astype(jnp.int32)), measuring)
+            status, backoff_until, stats = recon_defer(
+                stats, workload, txn_type, free, status, backoff_until, t,
+                measuring)
 
         txn = TxnState(status=status, cursor=cursor, ts=ts, pool_idx=pool_idx,
                        restarts=restarts, backoff_until=backoff_until,
@@ -161,6 +159,10 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
         active = (txn.status == STATUS_RUNNING) | (txn.status == STATUS_WAITING)
         ridx = jnp.broadcast_to(jnp.arange(R, dtype=jnp.int32), (B, R))
         finishing = (txn.status == STATUS_RUNNING) & (txn.cursor >= txn.n_req)
+        if cfg.logging:
+            # commit blocks on the LOG_FLUSHED (+ replica ack) round trip
+            # (worker_thread.cpp:535-554); stamped at last-grant below
+            finishing = finishing & (txn.backoff_until <= t)
         # workload rollback (TPC-C rbk): frees the slot, no effects, no votes
         ua = workload.user_abort(cfg, txn, finishing)
         finishing = finishing & ~ua
@@ -384,6 +386,35 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
                 {f: recvB[f].reshape(-1) for f in workload.effect_fields},
                 rB_cts, rB_commit)
 
+        # ---- command log + replication (home side) ----
+        if cfg.logging:
+            wflat = (commit[:, None] & txn.is_write
+                     & (ridx < txn.n_req[:, None])).reshape(-1)
+            tid_e = jnp.broadcast_to(txn.pool_idx[:, None],
+                                     (B, R)).reshape(-1)
+            stats = append_log_ring(stats, cfg, wflat, key_g, tid_e)
+            if cfg.repl_cnt > 0:
+                # ship this tick's records to the successor shard (the
+                # LOG_MSG -> replica -> LOG_MSG_RSP path, worker_thread.cpp:
+                # 527-554, active-active layout: each shard replicates its
+                # log on its ring neighbor); the ack latency is inside
+                # log_flush_ticks
+                recs = jnp.where(wflat, key_g, NULL_KEY)
+                perm = [(i, (i + 1) % n_nodes) for i in range(n_nodes)]
+                rrecs = jax.lax.ppermute(recs, AXIS, perm)
+                rlive = rrecs != NULL_KEY
+                rrank = jnp.cumsum(rlive.astype(jnp.int32)) - rlive.astype(
+                    jnp.int32)
+                rpos2 = jnp.where(rlive,
+                                  (stats["repl_lsn"] + rrank)
+                                  % cfg.log_buf_cap,
+                                  cfg.log_buf_cap)
+                stats = {**stats,
+                         "arr_repl_key": stats["arr_repl_key"].at[
+                             rpos2].set(rrecs, mode="drop"),
+                         "repl_lsn": stats["repl_lsn"]
+                         + jnp.sum(rlive.astype(jnp.int32))}
+
         # ---- 6. commit/abort bookkeeping (home) ----
         n_commit = jnp.sum(commit.astype(jnp.int32))
         stats = bump(stats, "txn_cnt", n_commit, measuring)
@@ -393,34 +424,9 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
         stats = bump(stats, "vabort_cnt",
                      jnp.sum(vabort.astype(jnp.int32)), measuring)
 
-        # partitions touched per commit (partitions_touched analog)
-        if n_nodes > 1 and n_nodes <= 31:
-            amask = ridx < txn.n_req[:, None]
-            bits = jnp.where(amask, jnp.int32(1) << (txn.keys % n_nodes), 0)
-            pbits = jnp.zeros(B, jnp.int32)
-            for r in range(R):
-                pbits = pbits | bits[:, r]
-            npart = jax.lax.population_count(pbits)
-            stats = bump(stats, "parts_touched",
-                         jnp.sum(jnp.where(commit, npart, 0)), measuring)
-            stats = bump(stats, "multi_part_txn_cnt",
-                         jnp.sum((commit & (npart > 1)).astype(jnp.int32)),
-                         measuring)
-        else:
-            stats = bump(stats, "parts_touched", n_commit, measuring)
-
-        # commit-latency sampling ring (StatsArr analog)
-        from deneva_tpu.engine.scheduler import LAT_SAMPLES
-        crank = jnp.cumsum(commit.astype(jnp.int32)) - commit.astype(jnp.int32)
-        rec = commit & measuring
-        rpos = jnp.where(rec,
-                         (stats["lat_ring_cursor"] + crank) % LAT_SAMPLES,
-                         LAT_SAMPLES)
-        stats = {**stats,
-                 "arr_lat_short": stats["arr_lat_short"].at[rpos].set(
-                     t - txn.start_tick, mode="drop"),
-                 "lat_ring_cursor": stats["lat_ring_cursor"]
-                 + jnp.where(measuring, n_commit, 0)}
+        stats = track_parts_touched(stats, txn, commit, n_nodes, measuring)
+        stats = record_commit_latency(stats, commit, t, txn.start_tick,
+                                      measuring)
         stats = bump(stats, "unique_txn_abort_cnt",
                      jnp.sum((commit & (txn.restarts > 0)).astype(jnp.int32)),
                      measuring)
@@ -444,7 +450,14 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
             cfg.abort_penalty_ticks).astype(jnp.int32)
         status = jnp.where(abort_now, STATUS_BACKOFF, status)
         cursor = jnp.where(abort_now, 0, cursor)
-        backoff_until = jnp.where(abort_now, t + penalty, txn.backoff_until)
+        backoff_base = txn.backoff_until
+        if cfg.logging:
+            reached = has_req & ~abort_now \
+                & (new_cursor >= txn.n_req) & (txn.cursor < txn.n_req)
+            backoff_base = jnp.where(reached,
+                                     t + 1 + cfg.log_flush_ticks,
+                                     backoff_base)
+        backoff_until = jnp.where(abort_now, t + penalty, backoff_base)
         restarts2 = jnp.where(abort_now, txn.restarts + 1, txn.restarts)
         txn = txn._replace(status=status, cursor=cursor,
                            backoff_until=backoff_until, restarts=restarts2)
@@ -452,15 +465,7 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
 
         # latency decomposition integrals (txn-ticks per end-of-tick state;
         # network = entry-ticks shipped to remote owners this tick)
-        stats = bump(stats, "lat_process_time",
-                     jnp.sum((txn.status == STATUS_RUNNING).astype(jnp.int32)),
-                     measuring)
-        stats = bump(stats, "lat_cc_block_time",
-                     jnp.sum((txn.status == STATUS_WAITING).astype(jnp.int32)),
-                     measuring)
-        stats = bump(stats, "lat_abort_time",
-                     jnp.sum((txn.status == STATUS_BACKOFF).astype(jnp.int32)),
-                     measuring)
+        stats = track_state_latencies(stats, txn, measuring)
         stats = bump(stats, "lat_network_time",
                      jnp.sum((live_e & (dest != node_id)).astype(jnp.int32)),
                      measuring)
@@ -496,6 +501,8 @@ class ShardedEngine:
                  devices=None):
         assert cfg.node_cnt >= 1
         assert cfg.part_cnt == cfg.node_cnt, "part striping == node striping"
+        assert cfg.mode == "NORMAL", \
+            "the MODE debug ladder is a single-shard isolation tool"
         self.cfg = cfg
         self.plugin = cc_registry.get(cfg.cc_alg)
         self.workload = wl_registry.get(cfg)
@@ -576,7 +583,7 @@ class ShardedEngine:
                 db=db,
                 data=jnp.zeros(rows_local, jnp.int32),
                 tables=self.workload.init_tables(cfg, part),
-                stats={**_zeros_stats(),
+                stats={**_zeros_stats(cfg),
                        **{k: jnp.zeros((), jnp.int32)
                           for k in SHARD_STAT_KEYS}},
                 tick=jnp.zeros((), jnp.int32),

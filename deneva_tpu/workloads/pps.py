@@ -107,7 +107,11 @@ class PPSWorkload(WorkloadPlugin):
         return rng, uses, supplies
 
     def gen_pool(self, cfg: Config, seed: int | None = None) -> QueryPool:
-        rng, uses, supplies = self._load(cfg)
+        # chains always derive from cfg.seed (they are the LOADER's state
+        # and must match init_tables); `seed` varies only the query draws
+        _, uses, supplies = self._load(cfg)
+        rng = np.random.default_rng(
+            [cfg.seed if seed is None else seed, 0x9951])
         cat = catalog(cfg)
         P = cfg.part_cnt
         Q = cfg.query_pool_size

@@ -1,0 +1,62 @@
+"""Debug mode ladder tests (reference MODE, config.h:314-319): each mode
+strips one more layer — NOCC disables CC, QRY_ONLY also skips row writes,
+SIMPLE acks at admission — so comparing adjacent rungs isolates where
+throughput goes (the reference's bottleneck-hunting methodology,
+SURVEY.md §4.4)."""
+
+import numpy as np
+import pytest
+
+from deneva_tpu.config import CC_ALGS, Config
+from deneva_tpu.engine.scheduler import Engine
+
+
+def run(mode, alg="NO_WAIT", ticks=40, **kw):
+    base = dict(cc_alg=alg, mode=mode, batch_size=128,
+                synth_table_size=1 << 10, req_per_query=4, zipf_theta=0.9,
+                query_pool_size=1 << 10)
+    base.update(kw)
+    eng = Engine(Config(**base))
+    st = eng.run(ticks)
+    return eng.summary(st), st
+
+
+@pytest.mark.parametrize("alg", CC_ALGS)
+def test_nocc_never_aborts(alg):
+    s, st = run("NOCC", alg=alg)
+    assert s["total_txn_abort_cnt"] == 0
+    assert s["txn_cnt"] > 0
+    # writes still applied in NOCC (row.cpp:199 returns the real row)
+    assert int(np.asarray(st.data).sum()) == s["write_cnt"]
+
+
+def test_qry_only_applies_no_writes():
+    s, st = run("QRY_ONLY")
+    assert s["txn_cnt"] > 0
+    assert int(np.asarray(st.data).sum()) == 0
+
+
+def test_simple_commits_without_executing():
+    s, st = run("SIMPLE")
+    assert s["txn_cnt"] > 0
+    assert int(np.asarray(st.data).sum()) == 0
+    # acked immediately: one tick of latency for every txn
+    assert s["avg_latency_ticks_short"] <= 1.0
+
+
+def test_ladder_orders_throughput():
+    """Each stripped layer can only help: NORMAL <= NOCC <= SIMPLE commits
+    under contention (the diagnostic signal the ladder exists for)."""
+    n0, _ = run("NORMAL")
+    n1, _ = run("NOCC")
+    n3, _ = run("SIMPLE")
+    assert n0["txn_cnt"] <= n1["txn_cnt"] <= n3["txn_cnt"]
+
+
+def test_nocc_matches_nolock_isolation():
+    """MODE NOCC and isolation NOLOCK disable CC through different gates
+    (mode ladder vs isolation level) and must agree for 2PL."""
+    a, _ = run("NOCC")
+    b, _ = run("NORMAL", isolation_level="NOLOCK")
+    assert a["txn_cnt"] == b["txn_cnt"]
+    assert a["write_cnt"] == b["write_cnt"]
