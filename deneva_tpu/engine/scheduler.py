@@ -320,149 +320,181 @@ def make_tick(cfg: Config, plugin, pool_dev: dict, workload=None):
         if normal:
             db = plugin.on_start(cfg, db, txn, free | expire)
 
-        # ---- 3. commit phase ----
-        finishing = (txn.status == STATUS_RUNNING) & (txn.cursor >= txn.n_req)
-        if cfg.logging:
-            # commit blocks until the LOG_FLUSHED ack (worker_thread.cpp:
-            # 535-554): the access phase stamps backoff_until with the
-            # flush-ready tick when the last access grants
-            finishing = finishing & (txn.backoff_until <= t)
-        # workload rollback (TPC-C rbk at TPCC_FIN, tpcc_txn.cpp:485-489):
-        # releases CC state like an abort but frees the slot, no effects
-        ua = workload.user_abort(cfg, txn, finishing)
-        finishing = finishing & ~ua
-        if normal:
-            ok, db = plugin.validate(cfg, db, txn, finishing, t)
-        else:
-            ok = finishing
-        commit = finishing & ok
-        vabort = finishing & ~ok
-        if normal:
-            db = plugin.on_commit(cfg, db, txn, commit, commit_ts=txn.ts,
-                                  tick=t)
-
+        # ---- 3/4. commit + access phases (order set by
+        # cfg.commit_after_access; the sequential oracle mirrors it) ----
         ridx = jnp.arange(txn.R, dtype=jnp.int32)[None, :]
-        wmask = commit[:, None] & txn.is_write & (ridx < txn.n_req[:, None])
-        if apply_writes:
-            # dead lanes scatter to an out-of-bounds index and are dropped
-            # (adding 0 at a real key would still serialize on hot rows)
-            data = data.at[jnp.where(wmask, txn.keys,
-                                     jnp.int32(2**31 - 1)).reshape(-1)].add(
-                1, mode="drop")
 
-        if cfg.logging:
-            tid_e = jnp.broadcast_to(txn.pool_idx[:, None],
-                                     (txn.B, txn.R)).reshape(-1)
-            stats = append_log_ring(stats, cfg, wmask.reshape(-1),
-                                    txn.keys.reshape(-1), tid_e)
+        def commit_block(txn, db, data, tables, stats):
+            finishing = (txn.status == STATUS_RUNNING) \
+                & (txn.cursor >= txn.n_req)
+            if cfg.logging:
+                # commit blocks until the LOG_FLUSHED ack
+                # (worker_thread.cpp:535-554): the access phase stamps
+                # backoff_until with the flush-ready tick at last grant
+                finishing = finishing & (txn.backoff_until <= t)
+            # workload rollback (TPC-C rbk at TPCC_FIN, tpcc_txn.cpp:
+            # 485-489): releases CC state like an abort, frees the slot
+            ua = workload.user_abort(cfg, txn, finishing)
+            finishing = finishing & ~ua
+            if normal:
+                ok, db = plugin.validate(cfg, db, txn, finishing, t)
+            else:
+                ok = finishing
+            commit = finishing & ok
+            vabort = finishing & ~ok
+            if normal:
+                db = plugin.on_commit(cfg, db, txn, commit,
+                                      commit_ts=txn.ts, tick=t)
 
-        if workload.has_effects and apply_writes:
-            # single-shard: catalog keys are shard-local (part_cnt == 1).
-            # Within-tick effect order follows the COMMIT timestamp (MaaT's
-            # find_bound lower), matching the sharded engine's exchange B.
-            cts = db[plugin.commit_ts_field] if plugin.commit_ts_field \
-                else txn.ts
-            flds = workload.commit_fields(cfg, tables, txn, commit)
-            nmask = (commit[:, None] & (ridx < txn.n_req[:, None]))
-            tables = workload.apply_commit_entries(
-                cfg, tables, txn.keys.reshape(-1), 0,
-                {k: v.reshape(-1) for k, v in flds.items()},
-                jnp.broadcast_to(cts[:, None], txn.keys.shape).reshape(-1),
-                nmask.reshape(-1))
+            wmask = commit[:, None] & txn.is_write \
+                & (ridx < txn.n_req[:, None])
+            if apply_writes:
+                # dead lanes scatter to an out-of-bounds index and drop
+                # (adding 0 at a real key would serialize on hot rows)
+                data = data.at[jnp.where(
+                    wmask, txn.keys,
+                    jnp.int32(2**31 - 1)).reshape(-1)].add(1, mode="drop")
 
-        n_commit = jnp.sum(commit.astype(jnp.int32))
-        stats = bump(stats, "txn_cnt", n_commit, measuring)
-        stats = bump(stats, "write_cnt",
-                     jnp.sum(wmask.astype(jnp.int32)), measuring)
-        stats = bump(stats, "vabort_cnt",
-                     jnp.sum(vabort.astype(jnp.int32)), measuring)
+            if cfg.logging:
+                tid_e = jnp.broadcast_to(txn.pool_idx[:, None],
+                                         (txn.B, txn.R)).reshape(-1)
+                stats = append_log_ring(stats, cfg, wmask.reshape(-1),
+                                        txn.keys.reshape(-1), tid_e)
 
-        stats = track_parts_touched(stats, txn, commit, cfg.part_cnt,
-                                    measuring)
-        stats = record_commit_latency(stats, commit, t, txn.start_tick,
-                                      measuring)
-        stats = bump(stats, "unique_txn_abort_cnt",
-                     jnp.sum((commit & (txn.restarts > 0)).astype(jnp.int32)),
-                     measuring)
-        stats = bump(stats, "txn_run_time_ticks",
-                     jnp.sum(jnp.where(commit, t - txn.start_tick, 0)), measuring)
-        stats = bump(stats, "txn_total_time_ticks",
-                     jnp.sum(jnp.where(commit, t - txn.first_start_tick, 0)),
-                     measuring)
+            if workload.has_effects and apply_writes:
+                # single-shard: catalog keys are shard-local (part_cnt==1).
+                # Within-tick effect order follows the COMMIT timestamp
+                # (MaaT's find_bound lower), like the sharded exchange B.
+                cts = db[plugin.commit_ts_field] if plugin.commit_ts_field \
+                    else txn.ts
+                flds = workload.commit_fields(cfg, tables, txn, commit)
+                nmask = (commit[:, None] & (ridx < txn.n_req[:, None]))
+                tables = workload.apply_commit_entries(
+                    cfg, tables, txn.keys.reshape(-1), 0,
+                    {k: v.reshape(-1) for k, v in flds.items()},
+                    jnp.broadcast_to(cts[:, None],
+                                     txn.keys.shape).reshape(-1),
+                    nmask.reshape(-1))
 
-        stats = bump(stats, "user_abort_cnt",
-                     jnp.sum(ua.astype(jnp.int32)), measuring)
-        status = jnp.where(commit | ua, STATUS_FREE, txn.status)
-        txn = txn._replace(status=status)
+            n_commit = jnp.sum(commit.astype(jnp.int32))
+            stats = bump(stats, "txn_cnt", n_commit, measuring)
+            stats = bump(stats, "write_cnt",
+                         jnp.sum(wmask.astype(jnp.int32)), measuring)
+            stats = bump(stats, "vabort_cnt",
+                         jnp.sum(vabort.astype(jnp.int32)), measuring)
+            stats = track_parts_touched(stats, txn, commit, cfg.part_cnt,
+                                        measuring)
+            stats = record_commit_latency(stats, commit, t, txn.start_tick,
+                                          measuring)
+            stats = bump(stats, "unique_txn_abort_cnt",
+                         jnp.sum((commit
+                                  & (txn.restarts > 0)).astype(jnp.int32)),
+                         measuring)
+            stats = bump(stats, "txn_run_time_ticks",
+                         jnp.sum(jnp.where(commit, t - txn.start_tick, 0)),
+                         measuring)
+            stats = bump(stats, "txn_total_time_ticks",
+                         jnp.sum(jnp.where(commit,
+                                           t - txn.first_start_tick, 0)),
+                         measuring)
+            stats = bump(stats, "user_abort_cnt",
+                         jnp.sum(ua.astype(jnp.int32)), measuring)
+            txn = txn._replace(status=jnp.where(commit | ua, STATUS_FREE,
+                                                txn.status))
+            return txn, db, data, tables, stats, vabort, ua
 
-        # ---- 4. access phase ----
-        active = ((txn.status == STATUS_RUNNING) | (txn.status == STATUS_WAITING)) \
-            & ~vabort
-        has_req = active & (txn.cursor < txn.n_req)
-        if normal:
-            dec, db = plugin.access(cfg, db, txn, active)
+        def access_block(txn, db, stats, vabort):
+            """vabort: validation-aborted txns from a PRECEDING commit
+            block (empty in commit_after_access mode)."""
+            active = ((txn.status == STATUS_RUNNING)
+                      | (txn.status == STATUS_WAITING)) & ~vabort
+            has_req = active & (txn.cursor < txn.n_req)
+            if normal:
+                dec, db = plugin.access(cfg, db, txn, active)
+            else:
+                from deneva_tpu.cc.base import AccessDecision
+                reqm = (active[:, None] & (ridx >= txn.cursor[:, None])
+                        & (ridx < txn.cursor[:, None] + cfg.acquire_window)
+                        & (ridx < txn.n_req[:, None]))
+                z = jnp.zeros_like(reqm)
+                dec = AccessDecision(grant=reqm, wait=z, abort=z)
+
+            # advance over the granted prefix; the wait/abort outcome is
+            # the first non-granted requested access's decision
+            ok = dec.grant | (ridx < txn.cursor[:, None]) \
+                | (ridx >= txn.n_req[:, None])
+            prefix = jnp.cumprod(ok.astype(jnp.int32), axis=1)
+            new_cursor = jnp.minimum(jnp.sum(prefix, axis=1), txn.n_req)
+            fail_pos = jnp.minimum(new_cursor, txn.R - 1)[:, None]
+            # fail-position lookup via masked reduction (gathers are slow
+            # on TPU; elementwise compare + any() is free)
+            at_fail = lambda m: jnp.any(m & (ridx == fail_pos), axis=1)
+            blocked = has_req & (new_cursor < txn.n_req)
+            wait = blocked & at_fail(dec.wait)
+            abort_now = (blocked & at_fail(dec.abort)) | vabort
+
+            cursor = jnp.where(has_req & ~abort_now, new_cursor, txn.cursor)
+            status = jnp.where(has_req & (new_cursor > txn.cursor),
+                               STATUS_RUNNING, txn.status)
+            status = jnp.where(wait, STATUS_WAITING, status)
+            stats = bump(stats, "twopl_wait_cnt",
+                         jnp.sum(wait.astype(jnp.int32)), measuring)
+
+            # abort processing: exponential backoff (abort_queue.cpp:26-82)
+            stats = bump(stats, "total_txn_abort_cnt",
+                         jnp.sum(abort_now.astype(jnp.int32)), measuring)
+            penalty = _penalty(txn.restarts)
+            status = jnp.where(abort_now, STATUS_BACKOFF, status)
+            cursor = jnp.where(abort_now, 0, cursor)
+            backoff_base = txn.backoff_until
+            if cfg.logging:
+                # L_NOTIFY + flush latency: stamp the commit-ready tick at
+                # last grant (logger.cpp:157-172); commit normally runs at
+                # t+1, so flush_ticks=1 costs exactly one extra tick
+                reached = has_req & ~abort_now \
+                    & (new_cursor >= txn.n_req) & (txn.cursor < txn.n_req)
+                flush_at = t + cfg.log_flush_ticks \
+                    + (0 if cfg.commit_after_access else 1)
+                backoff_base = jnp.where(reached, flush_at, backoff_base)
+            backoff_until = jnp.where(abort_now, t + penalty, backoff_base)
+            restarts2 = jnp.where(abort_now, txn.restarts + 1, txn.restarts)
+            txn = txn._replace(status=status, cursor=cursor,
+                               backoff_until=backoff_until,
+                               restarts=restarts2)
+            return txn, db, stats, abort_now
+
+        def _penalty(restarts):
+            shift = jnp.minimum(restarts, 16)
+            return jnp.where(
+                jnp.asarray(cfg.backoff),
+                jnp.minimum(cfg.abort_penalty_ticks * (1 << shift),
+                            cfg.abort_penalty_max_ticks),
+                cfg.abort_penalty_ticks).astype(jnp.int32)
+
+        if not cfg.commit_after_access:
+            txn, db, data, tables, stats, vabort, ua = commit_block(
+                txn, db, data, tables, stats)
+            txn, db, stats, abort_now = access_block(txn, db, stats, vabort)
+            db = plugin.on_abort(cfg, db, txn, abort_now | ua) if normal \
+                else db
         else:
-            from deneva_tpu.cc.base import AccessDecision
-            ridx_m = jnp.arange(txn.R, dtype=jnp.int32)[None, :]
-            reqm = (active[:, None] & (ridx_m >= txn.cursor[:, None])
-                    & (ridx_m < txn.cursor[:, None] + cfg.acquire_window)
-                    & (ridx_m < txn.n_req[:, None]))
-            z = jnp.zeros_like(reqm)
-            dec = AccessDecision(grant=reqm, wait=z, abort=z)
-
-        # advance each txn over the granted prefix of its access program;
-        # the wait/abort outcome is whatever the first non-granted requested
-        # access decided (grants past it are dropped — next tick re-requests)
-        R = txn.R
-        ridx2 = jnp.arange(R, dtype=jnp.int32)[None, :]
-        ok = dec.grant | (ridx2 < txn.cursor[:, None]) \
-            | (ridx2 >= txn.n_req[:, None])
-        prefix = jnp.cumprod(ok.astype(jnp.int32), axis=1)
-        new_cursor = jnp.minimum(jnp.sum(prefix, axis=1), txn.n_req)
-        fail_pos = jnp.minimum(new_cursor, R - 1)[:, None]
-        # value at the fail position via masked reduction (gathers are slow
-        # on TPU; an elementwise compare + any() is free)
-        at_fail = lambda m: jnp.any(m & (ridx2 == fail_pos), axis=1)
-        blocked = has_req & (new_cursor < txn.n_req)
-        wait = blocked & at_fail(dec.wait)
-        abort_now = (blocked & at_fail(dec.abort)) | vabort
-
-        cursor = jnp.where(has_req & ~abort_now, new_cursor, txn.cursor)
-        status = jnp.where(has_req & (new_cursor > txn.cursor), STATUS_RUNNING,
-                           txn.status)
-        status = jnp.where(wait, STATUS_WAITING, status)
-        stats = bump(stats, "twopl_wait_cnt",
-                     jnp.sum(wait.astype(jnp.int32)), measuring)
-
-        # ---- 5. abort processing: exponential backoff ----
-        stats = bump(stats, "total_txn_abort_cnt",
-                     jnp.sum(abort_now.astype(jnp.int32)), measuring)
-        shift = jnp.minimum(txn.restarts, 16)
-        penalty = jnp.where(
-            jnp.asarray(cfg.backoff),
-            jnp.minimum(cfg.abort_penalty_ticks * (1 << shift),
-                        cfg.abort_penalty_max_ticks),
-            cfg.abort_penalty_ticks).astype(jnp.int32)
-        status = jnp.where(abort_now, STATUS_BACKOFF, status)
-        cursor = jnp.where(abort_now, 0, cursor)
-        backoff_base = txn.backoff_until
-        if cfg.logging:
-            # L_NOTIFY at finish + flush latency: stamp the tick at which
-            # the commit may proceed (the LogThread flush + LOG_FLUSHED
-            # round trip, logger.cpp:157-172); the commit-phase gate above
-            # reads this.  Normal commit happens at t+1, so flush_ticks=1
-            # costs exactly one extra tick.
-            reached = has_req & ~abort_now \
-                & (new_cursor >= txn.n_req) & (txn.cursor < txn.n_req)
-            backoff_base = jnp.where(reached,
-                                     t + 1 + cfg.log_flush_ticks,
-                                     backoff_base)
-        backoff_until = jnp.where(abort_now, t + penalty, backoff_base)
-        restarts2 = jnp.where(abort_now, txn.restarts + 1, txn.restarts)
-        txn = txn._replace(status=status, cursor=cursor,
-                           backoff_until=backoff_until, restarts=restarts2)
-        if normal:
-            db = plugin.on_abort(cfg, db, txn, abort_now | ua)
+            z = jnp.zeros(txn.B, dtype=bool)
+            txn, db, stats, abort_now = access_block(txn, db, stats, z)
+            txn, db, data, tables, stats, vabort, ua = commit_block(
+                txn, db, data, tables, stats)
+            # validation aborts enter backoff here (the access block has
+            # already run); counted once, like the pre-ordering path
+            stats = bump(stats, "total_txn_abort_cnt",
+                         jnp.sum(vabort.astype(jnp.int32)), measuring)
+            txn = txn._replace(
+                status=jnp.where(vabort, STATUS_BACKOFF, txn.status),
+                cursor=jnp.where(vabort, 0, txn.cursor),
+                backoff_until=jnp.where(vabort,
+                                        t + _penalty(txn.restarts),
+                                        txn.backoff_until),
+                restarts=jnp.where(vabort, txn.restarts + 1, txn.restarts))
+            db = plugin.on_abort(cfg, db, txn, abort_now | vabort | ua) \
+                if normal else db
 
         # latency decomposition integrals: txn-ticks per end-of-tick state
         stats = track_state_latencies(stats, txn, measuring)
@@ -526,11 +558,17 @@ class Engine:
             ts_counter=jnp.ones((), jnp.int32),
         )
 
-    def run(self, n_ticks: int, state: EngineState | None = None) -> EngineState:
+    def run(self, n_ticks: int, state: EngineState | None = None,
+            prog_every: int | None = None) -> EngineState:
+        """Host-stepped run; prog_every prints the reference's ``[prog]``
+        heartbeat line every that-many ticks (Thread::progress_stats,
+        system/thread.cpp:86-105)."""
         if state is None:
             state = self.init_state()
-        for _ in range(n_ticks):
+        for i in range(n_ticks):
             state = self._tick_jit(state)
+            if prog_every and (i + 1) % prog_every == 0:
+                print(self.summary_line(state, prog=True), flush=True)
         return state
 
     @functools.partial(jax.jit, static_argnums=(0, 1), donate_argnums=2)

@@ -83,7 +83,13 @@ def flush_engine_log(state, path: str, flushed_lsn: int = 0) -> int:
     lsn = int(np.asarray(state.stats["log_lsn"]))
     cap = state.stats["arr_log_key"].shape[0]
     pending = lsn - flushed_lsn
-    assert 0 <= pending <= cap, "log ring overwrote unflushed records"
+    if not 0 <= pending <= cap:
+        # a plain assert would vanish under python -O, and an overwritten
+        # ring re-stamps lsns/checksums so replay could NOT detect it —
+        # this is the one place the durability contract must hard-fail
+        raise IOError(
+            f"log ring overwrote unflushed records ({pending} pending > "
+            f"cap {cap}); flush at least every cap-commits")
     if pending == 0:
         return lsn
     keys = np.asarray(state.stats["arr_log_key"])

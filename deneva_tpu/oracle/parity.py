@@ -38,10 +38,18 @@ def _pair_dict(cfg: Config, b: dict, b_data_sum: int, seq) -> dict:
 def run_pair(cfg: Config, n_ticks: int) -> dict:
     """Run both engines on one shared pool; return their stats + divergence.
 
-    Workload-agnostic: the oracle replays any QueryPool's (keys, is_write)
-    footprints, so TPC-C / PPS parity cells come for free."""
+    The oracle replays any QueryPool's (keys, is_write) footprints, so
+    TPC-C / PPS parity cells come for free — EXCEPT paths the oracle does
+    not model: workload user-aborts (TPC-C rbk) and the Calvin recon
+    deferral.  Such configs are rejected so a schedule mismatch can't be
+    misread as CC-kernel divergence."""
     from deneva_tpu import workloads as wl_registry
-    pool = wl_registry.get(cfg).gen_pool(cfg)
+    workload = wl_registry.get(cfg)
+    assert cfg.tpcc_rbk_perc == 0, \
+        "oracle does not model user-aborts; parity needs rbk off"
+    assert not (cfg.cc_alg == "CALVIN" and workload.recon_types), \
+        "oracle does not model the Calvin recon deferral"
+    pool = workload.gen_pool(cfg)
 
     eng = Engine(cfg, pool=pool)
     st = eng.run(n_ticks)

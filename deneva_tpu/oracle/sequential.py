@@ -585,11 +585,13 @@ class SequentialEngine:
         #   through this tick's arbitration (parallel/sharded.py) — the
         #   analog of the reference holding locks across the 2PC
         #   prepare/finish rounds (system/txn.cpp:487-554).
-        finishing = [x for x in self.txns
-                     if x.status == RUNNING and x.cursor >= x.n_req]
+        def fresh_finishing():
+            return [x for x in self.txns
+                    if x.status == RUNNING and x.cursor >= x.n_req]
+
         val_aborted = set()
 
-        def commit_phase():
+        def commit_phase(finishing):
             for txn in sorted(finishing, key=lambda x: x.ts):
                 if man.validate(txn, t):
                     man.commit(txn, t)
@@ -605,8 +607,9 @@ class SequentialEngine:
                     val_aborted.add(txn.slot)   # slots globally unique
                     self._abort(txn)
 
-        if self.N == 1:
-            commit_phase()
+        if self.N == 1 and not cfg.commit_after_access:
+            commit_phase(fresh_finishing())
+        snapshot = fresh_finishing() if self.N > 1 else None
 
         # access phase (ts order, window accesses per txn)
         active = [x for x in self.txns
@@ -642,7 +645,13 @@ class SequentialEngine:
                     break
 
         if self.N > 1:
-            commit_phase()
+            # sharded ordering: commit the txns that were finishing at tick
+            # START (their locks stayed held through this arbitration)
+            commit_phase(snapshot)
+        elif cfg.commit_after_access:
+            # post-access ordering: txns commit the same tick their last
+            # access granted (Config.commit_after_access)
+            commit_phase(fresh_finishing())
 
         self.tick += 1
 

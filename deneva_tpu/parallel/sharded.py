@@ -609,12 +609,15 @@ class ShardedEngine:
             lambda st: f(st, self.pool_stacked, self._node_idx),
             donate_argnums=0)
 
-    def run(self, n_ticks: int, state: ShardState | None = None) -> ShardState:
+    def run(self, n_ticks: int, state: ShardState | None = None,
+            prog_every: int | None = None) -> ShardState:
         self._build()
         if state is None:
             state = self.init_state()
-        for _ in range(n_ticks):
+        for i in range(n_ticks):
             state = self._jit_tick(state)
+            if prog_every and (i + 1) % prog_every == 0:
+                print(self.summary_line(state, prog=True), flush=True)
         return state
 
     def run_compiled(self, n_ticks: int, state=None):

@@ -1,0 +1,118 @@
+"""Plot the sweep grids — the rebuild of scripts/plot.py / paper_plots.py.
+
+Reads the cached per-cell results (results/<grid>.<alg>.<idx>.json, written
+by run_grid.py) and renders the VLDB'17-style curves: throughput vs node
+count and throughput/abort-rate vs zipf theta, one line per CC algorithm.
+
+Chart conventions (dataviz method): line form for change-over-a-dimension;
+categorical hues assigned in a FIXED validated order (the reference
+palette's slots 1-7, pre-validated for adjacent-pair CVD separation on a
+white surface); one axis per panel; recessive grid; legend present (7
+series is past the direct-label budget); text in ink, not series colors.
+
+Usage: python experiments/plot_results.py   (writes results/plots/*.png)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt  # noqa: E402
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+RESULTS_DIR = os.path.join(os.path.dirname(__file__), "..", "results")
+PLOTS_DIR = os.path.join(RESULTS_DIR, "plots")
+
+ALGS = ("NO_WAIT", "WAIT_DIE", "TIMESTAMP", "MVCC", "OCC", "MAAT", "CALVIN")
+#: fixed categorical order, the validated reference palette slots 1-7
+COLORS = ("#2a78d6", "#eb6834", "#1baf7a", "#eda100", "#e87ba4", "#008300",
+          "#4a3aa7")
+INK = "#333333"
+GRID = "#dddddd"
+
+
+def load(grid: str) -> dict:
+    rows = {}
+    for alg in ALGS:
+        idx = 0
+        while True:
+            path = os.path.join(RESULTS_DIR, f"{grid}.{alg}.{idx}.json")
+            if not os.path.exists(path):
+                break
+            with open(path) as f:
+                d = json.load(f)
+            rows.setdefault(alg, []).append(d)
+            idx += 1
+    return rows
+
+
+def style(ax, xlabel, ylabel, title):
+    ax.set_xlabel(xlabel, color=INK)
+    ax.set_ylabel(ylabel, color=INK)
+    ax.set_title(title, color=INK, fontsize=11)
+    ax.grid(True, color=GRID, linewidth=0.6, zorder=0)
+    for s in ("top", "right"):
+        ax.spines[s].set_visible(False)
+    for s in ("left", "bottom"):
+        ax.spines[s].set_color(GRID)
+    ax.tick_params(colors=INK, labelsize=8)
+
+
+def plot_lines(ax, rows, xs_of, y_of):
+    for alg, color in zip(ALGS, COLORS):
+        cells = rows.get(alg, [])
+        if not cells:
+            continue
+        xs = [xs_of(c) for c in cells]
+        ys = [y_of(c) for c in cells]
+        order = sorted(range(len(xs)), key=lambda i: xs[i])
+        ax.plot([xs[i] for i in order], [ys[i] for i in order],
+                color=color, linewidth=2, marker="o", markersize=5,
+                label=alg, zorder=3)
+
+
+def main():
+    os.makedirs(PLOTS_DIR, exist_ok=True)
+
+    for grid, xlabel, xs_of in (
+            ("ycsb_scaling", "nodes", lambda c: int(c["cell"].split("-n")[1])),
+            ("tpcc_scaling", "nodes", lambda c: int(c["cell"].split("-n")[1]))):
+        rows = load(grid)
+        if not rows:
+            continue
+        fig, ax = plt.subplots(figsize=(5.2, 3.4), dpi=150)
+        plot_lines(ax, rows, xs_of, lambda c: c["row"]["txn_cnt"])
+        style(ax, xlabel, "committed txns (30 measured ticks)",
+              f"{grid}: total commits vs cluster size")
+        ax.legend(fontsize=7, frameon=False, ncol=2, labelcolor=INK)
+        fig.tight_layout()
+        fig.savefig(os.path.join(PLOTS_DIR, f"{grid}.png"))
+        plt.close(fig)
+
+    rows = load("ycsb_skew")
+    if rows:
+        theta_of = lambda c: float(c["cell"].split("-th")[1])  # noqa: E731
+        fig, (ax1, ax2) = plt.subplots(1, 2, figsize=(9.6, 3.4), dpi=150)
+        plot_lines(ax1, rows, theta_of, lambda c: c["row"]["tput_per_tick"])
+        style(ax1, "zipf theta", "commits per tick",
+              "ycsb_skew: throughput vs skew (8 nodes)")
+        ax1.legend(fontsize=7, frameon=False, ncol=2, labelcolor=INK)
+        plot_lines(ax2, rows, theta_of, lambda c: c["row"]["abort_rate"])
+        style(ax2, "zipf theta", "abort rate",
+              "ycsb_skew: abort rate vs skew")
+        ax2.set_ylim(-0.02, 1.0)
+        fig.tight_layout()
+        fig.savefig(os.path.join(PLOTS_DIR, "ycsb_skew.png"))
+        plt.close(fig)
+
+    print(f"wrote plots to {PLOTS_DIR}")
+
+
+if __name__ == "__main__":
+    main()

@@ -46,6 +46,16 @@ def test_subticked_parity_converges(alg):
     assert r["abort_rate_divergence"] <= 0.012, r
 
 
+@pytest.mark.parametrize("alg", ["NO_WAIT", "MVCC", "CALVIN"])
+def test_commit_after_access_parity(alg):
+    """The post-access commit ordering (Config.commit_after_access) is
+    mirrored by the oracle; parity must hold in that mode too."""
+    r = run_pair(Config(cc_alg=alg, commit_after_access=True, **CFG),
+                 n_ticks=50)
+    assert r["batched_conserved"] and r["sequential_conserved"], r
+    assert r["abort_rate_divergence"] <= 0.035, r
+
+
 def test_mvcc_ring_sized_parity():
     """With the version ring sized past eviction pressure the MVCC kernel
     is within noise of the unbounded-history reference."""
