@@ -194,6 +194,14 @@ class Config:
     # --- multi-shard routing ---
     route_capacity_factor: float = 2.0  # per-(src,dst) all_to_all capacity slack
 
+    #: per-tick event trace depth (the DEBUG_TIMELINE analog,
+    #: config.h:269 + scripts/timeline.py): when > 0, the engine records
+    #: admissions / commits / aborts / waiting per tick for the first
+    #: trace_ticks ticks, and the commit-latency ring also records start
+    #: ticks so recent txn lifetimes can be drawn
+    #: (experiments/timeline_plot.py).  0 = off (no trace arrays carried).
+    trace_ticks: int = 0
+
     # --- run protocol (reference config.h:349-350: 60s warmup + 60s run) ---
     seed: int = 12345
     query_pool_size: int = 1 << 16    # pre-generated queries (client_query.cpp:30)
@@ -203,6 +211,14 @@ class Config:
         assert self.workload in WORKLOADS, self.workload
         assert self.isolation_level in ISOLATION_LEVELS
         assert self.mode in MODES, self.mode
+        if self.commit_after_access:
+            # the sharded engine's protocol is already access-before-commit
+            # (exchange A then exchange B); the flag only reorders the
+            # single-shard tick — reject configs where it would silently
+            # do nothing
+            assert self.node_cnt == 1, \
+                "commit_after_access applies to the single-shard engine; " \
+                "the sharded tick already arbitrates before committing"
         if self.sub_ticks > 1:
             # only the 2PL family implements sub-round arbitration; fail
             # loudly rather than silently running one round

@@ -85,6 +85,12 @@ def _zeros_stats(cfg: Config | None = None) -> dict:
     s.update({k: jnp.zeros((), jnp.float32) for k in STAT_KEYS_F32})
     s["arr_lat_short"] = jnp.zeros(LAT_SAMPLES, jnp.int32)
     s["lat_ring_cursor"] = jnp.zeros((), jnp.int32)
+    if cfg is not None and cfg.trace_ticks > 0:
+        # per-tick event series (DEBUG_TIMELINE analog, scripts/timeline.py)
+        for k in ("arr_trace_admit", "arr_trace_commit", "arr_trace_abort",
+                  "arr_trace_waiting"):
+            s[k] = jnp.zeros(cfg.trace_ticks, jnp.int32)
+        s["arr_lat_start"] = jnp.zeros(LAT_SAMPLES, jnp.int32)
     if cfg is not None and cfg.logging:
         # command-log ring (Logger's log_file ring, system/logger.cpp:60-117:
         # one L_UPDATE record per committed write: lsn/txn_id/key)
@@ -158,6 +164,16 @@ def pool_admit(pool_dev: dict, txn: TxnState, admit, frank, pool_cursor,
     return keys, is_write, n_req, txn_type, targs, aux, pool_idx
 
 
+def trace_add(stats: dict, key: str, t, amount) -> dict:
+    """Record a per-tick event count into the trace series (present only
+    when Config.trace_ticks > 0; ticks past the depth are dropped)."""
+    if key not in stats:
+        return stats
+    T = stats[key].shape[0]
+    idx = jnp.where(t < T, t, T)
+    return {**stats, key: stats[key].at[idx].add(amount, mode="drop")}
+
+
 def bump(stats: dict, key: str, amount, measuring) -> dict:
     """Warmup-gated counter increment (INC_STATS + is_warmup_done,
     system/helper.h:136-150)."""
@@ -174,11 +190,15 @@ def record_commit_latency(stats: dict, commit, t, start_tick,
     pos = jnp.where(rec, (stats["lat_ring_cursor"] + crank) % LAT_SAMPLES,
                     LAT_SAMPLES)
     n_commit = jnp.sum(commit.astype(jnp.int32))
-    return {**stats,
-            "arr_lat_short": stats["arr_lat_short"].at[pos].set(
-                t - start_tick, mode="drop"),
-            "lat_ring_cursor": stats["lat_ring_cursor"]
-            + jnp.where(measuring, n_commit, 0)}
+    out = {**stats,
+           "arr_lat_short": stats["arr_lat_short"].at[pos].set(
+               t - start_tick, mode="drop"),
+           "lat_ring_cursor": stats["lat_ring_cursor"]
+           + jnp.where(measuring, n_commit, 0)}
+    if "arr_lat_start" in stats:   # timeline trace: lifetime = (start, dur)
+        out["arr_lat_start"] = stats["arr_lat_start"].at[pos].set(
+            start_tick, mode="drop")
+    return out
 
 
 def track_parts_touched(stats: dict, txn: TxnState, commit, n_parts: int,
@@ -229,6 +249,19 @@ def track_state_latencies(stats: dict, txn: TxnState, measuring) -> dict:
         stats = bump(stats, key,
                      jnp.sum((txn.status == st_v).astype(jnp.int32)),
                      measuring)
+    return stats
+
+
+def trace_tick_events(stats: dict, t, n_admit, n_commit, n_abort,
+                      txn: TxnState) -> dict:
+    """Per-tick timeline series (DEBUG_TIMELINE analog): no-ops unless the
+    trace arrays exist."""
+    stats = trace_add(stats, "arr_trace_admit", t, n_admit)
+    stats = trace_add(stats, "arr_trace_commit", t, n_commit)
+    stats = trace_add(stats, "arr_trace_abort", t, n_abort)
+    stats = trace_add(
+        stats, "arr_trace_waiting", t,
+        jnp.sum((txn.status == STATUS_WAITING).astype(jnp.int32)))
     return stats
 
 
@@ -401,7 +434,7 @@ def make_tick(cfg: Config, plugin, pool_dev: dict, workload=None):
                          jnp.sum(ua.astype(jnp.int32)), measuring)
             txn = txn._replace(status=jnp.where(commit | ua, STATUS_FREE,
                                                 txn.status))
-            return txn, db, data, tables, stats, vabort, ua
+            return txn, db, data, tables, stats, commit, vabort, ua
 
         def access_block(txn, db, stats, vabort):
             """vabort: validation-aborted txns from a PRECEDING commit
@@ -472,16 +505,18 @@ def make_tick(cfg: Config, plugin, pool_dev: dict, workload=None):
                 cfg.abort_penalty_ticks).astype(jnp.int32)
 
         if not cfg.commit_after_access:
-            txn, db, data, tables, stats, vabort, ua = commit_block(
+            txn, db, data, tables, stats, commit, vabort, ua = commit_block(
                 txn, db, data, tables, stats)
             txn, db, stats, abort_now = access_block(txn, db, stats, vabort)
+            abort_total = abort_now          # includes vabort
             db = plugin.on_abort(cfg, db, txn, abort_now | ua) if normal \
                 else db
         else:
             z = jnp.zeros(txn.B, dtype=bool)
             txn, db, stats, abort_now = access_block(txn, db, stats, z)
-            txn, db, data, tables, stats, vabort, ua = commit_block(
+            txn, db, data, tables, stats, commit, vabort, ua = commit_block(
                 txn, db, data, tables, stats)
+            abort_total = abort_now | vabort
             # validation aborts enter backoff here (the access block has
             # already run); counted once, like the pre-ordering path
             stats = bump(stats, "total_txn_abort_cnt",
@@ -498,6 +533,10 @@ def make_tick(cfg: Config, plugin, pool_dev: dict, workload=None):
 
         # latency decomposition integrals: txn-ticks per end-of-tick state
         stats = track_state_latencies(stats, txn, measuring)
+        if cfg.trace_ticks > 0:
+            stats = trace_tick_events(
+                stats, t, n_free, jnp.sum(commit.astype(jnp.int32)),
+                jnp.sum(abort_total.astype(jnp.int32)), txn)
 
         # ts wraparound guard: only relative order matters, and every live
         # txn's ts lies within [ts_counter - horizon, ts_counter], so rebase

@@ -52,12 +52,13 @@ from jax import shard_map
 from deneva_tpu import cc as cc_registry
 from deneva_tpu import workloads as wl_registry
 from deneva_tpu.config import Config, TPCC
-from deneva_tpu.engine.scheduler import (STAT_KEYS_F32, STAT_KEYS_I32,
+from deneva_tpu.engine.scheduler import (STAT_KEYS_F32, STAT_KEYS_I32,  # noqa: E501
                                          _zeros_stats, append_log_ring,
                                          bump, recon_defer,
                                          record_commit_latency,
                                          track_parts_touched,
-                                         track_state_latencies)
+                                         track_state_latencies,
+                                         trace_tick_events)
 from deneva_tpu.engine.state import (BIG_TS, NULL_KEY, STATUS_BACKOFF,
                                      STATUS_FREE, STATUS_RUNNING,
                                      STATUS_WAITING, TxnState)
@@ -466,6 +467,10 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
         # latency decomposition integrals (txn-ticks per end-of-tick state;
         # network = entry-ticks shipped to remote owners this tick)
         stats = track_state_latencies(stats, txn, measuring)
+        if cfg.trace_ticks > 0:
+            stats = trace_tick_events(
+                stats, t, n_free, n_commit,
+                jnp.sum(abort_now.astype(jnp.int32)), txn)
         stats = bump(stats, "lat_network_time",
                      jnp.sum((live_e & (dest != node_id)).astype(jnp.int32)),
                      measuring)

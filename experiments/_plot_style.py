@@ -1,0 +1,27 @@
+"""Shared chart styling for the experiment plots (plot_results.py,
+timeline_plot.py): ink/grid tokens, the fixed validated categorical
+palette (reference-palette slots, pre-validated for adjacent-pair CVD
+separation on a white surface), and the recessive-axes styler."""
+
+from __future__ import annotations
+
+INK = "#333333"
+GRID = "#dddddd"
+#: fixed categorical order — never cycled, never re-ranked
+PALETTE = ("#2a78d6", "#eb6834", "#1baf7a", "#eda100", "#e87ba4", "#008300",
+           "#4a3aa7", "#e34948")
+
+
+def style_axes(ax, xlabel: str = "", ylabel: str = "", title: str = ""):
+    if xlabel:
+        ax.set_xlabel(xlabel, color=INK)
+    if ylabel:
+        ax.set_ylabel(ylabel, color=INK)
+    if title:
+        ax.set_title(title, color=INK, fontsize=11)
+    ax.grid(True, color=GRID, linewidth=0.6, zorder=0)
+    for s in ("top", "right"):
+        ax.spines[s].set_visible(False)
+    for s in ("left", "bottom"):
+        ax.spines[s].set_color(GRID)
+    ax.tick_params(colors=INK, labelsize=8)

@@ -163,6 +163,12 @@ def main():
         "snapshots (row_maat.cpp:64-95); seed-averaged bias ~+1% with "
         "comparable noise — the cost of set-snapshot-free batched "
         "validation, bounded and documented.",
+        "- **TIMESTAMP on TPC-C** (+4% +-3%): the same within-tick "
+        "abort-withdrawal timing as 2PL — an aborting txn's pending "
+        "prewrites block same-tick readers until tick end — amplified by "
+        "TPC-C's hot warehouse/district rows; the T/O family has no "
+        "sub_ticks refinement yet (the 2PL table above shows the class "
+        "converging to 0 under it).",
         "- **CALVIN**: exact (both sides deterministic and abort-free).",
         "",
     ]

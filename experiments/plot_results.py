@@ -29,39 +29,24 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 RESULTS_DIR = os.path.join(os.path.dirname(__file__), "..", "results")
 PLOTS_DIR = os.path.join(RESULTS_DIR, "plots")
 
+from experiments._plot_style import INK, PALETTE, style_axes as style  # noqa: E402,E501
+
 ALGS = ("NO_WAIT", "WAIT_DIE", "TIMESTAMP", "MVCC", "OCC", "MAAT", "CALVIN")
-#: fixed categorical order, the validated reference palette slots 1-7
-COLORS = ("#2a78d6", "#eb6834", "#1baf7a", "#eda100", "#e87ba4", "#008300",
-          "#4a3aa7")
-INK = "#333333"
-GRID = "#dddddd"
+COLORS = PALETTE[:7]
 
 
 def load(grid: str) -> dict:
+    """All cached cells per algorithm (glob, so a missing middle index
+    cannot silently truncate a curve)."""
+    import glob as _glob
     rows = {}
     for alg in ALGS:
-        idx = 0
-        while True:
-            path = os.path.join(RESULTS_DIR, f"{grid}.{alg}.{idx}.json")
-            if not os.path.exists(path):
-                break
+        paths = sorted(_glob.glob(
+            os.path.join(RESULTS_DIR, f"{grid}.{alg}.*.json")))
+        for path in paths:
             with open(path) as f:
-                d = json.load(f)
-            rows.setdefault(alg, []).append(d)
-            idx += 1
+                rows.setdefault(alg, []).append(json.load(f))
     return rows
-
-
-def style(ax, xlabel, ylabel, title):
-    ax.set_xlabel(xlabel, color=INK)
-    ax.set_ylabel(ylabel, color=INK)
-    ax.set_title(title, color=INK, fontsize=11)
-    ax.grid(True, color=GRID, linewidth=0.6, zorder=0)
-    for s in ("top", "right"):
-        ax.spines[s].set_visible(False)
-    for s in ("left", "bottom"):
-        ax.spines[s].set_color(GRID)
-    ax.tick_params(colors=INK, labelsize=8)
 
 
 def plot_lines(ax, rows, xs_of, y_of):

@@ -1,0 +1,86 @@
+"""Render the engine's event trace — the rebuild of scripts/timeline.py
+(which consumes DEBUG_TIMELINE printfs, config.h:269).
+
+Two panels from a run with Config.trace_ticks > 0:
+1. per-tick event series: admissions / commits / aborts / waiting slots —
+   the tensorized replacement for per-event printf lines;
+2. recent txn lifetimes: (start_tick, duration) segments from the
+   commit-latency sampling ring, one horizontal bar per committed txn —
+   the Gantt view timeline.py draws from per-txn start/commit events.
+
+Usage:
+    from experiments.timeline_plot import render
+    render(engine, state, "timeline.png")
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt  # noqa: E402
+
+from experiments._plot_style import INK, PALETTE, style_axes  # noqa: E402
+
+SERIES = {"admitted": PALETTE[0], "committed": PALETTE[2],
+          "aborted": PALETTE[1], "waiting slots": PALETTE[3]}
+
+
+def _series(stats, key, T):
+    """Per-tick trace series; sharded states carry (N, T) arrays — sum
+    the node axis for the cluster-wide view."""
+    a = np.asarray(stats[key])
+    if a.ndim == 2:
+        a = a.sum(axis=0)
+    return a[:T]
+
+
+def _lifetimes(stats):
+    """(start, duration) samples; per-node rings concatenate their valid
+    prefixes (matching ShardedEngine.summary)."""
+    dur = np.asarray(stats["arr_lat_short"])
+    start = np.asarray(stats["arr_lat_start"])
+    cur = np.asarray(stats["lat_ring_cursor"])
+    if dur.ndim == 2:
+        parts = [(start[i][:min(int(cur[i]), dur.shape[1])],
+                  dur[i][:min(int(cur[i]), dur.shape[1])])
+                 for i in range(dur.shape[0])]
+        return (np.concatenate([p[0] for p in parts]),
+                np.concatenate([p[1] for p in parts]))
+    n = min(int(cur), dur.shape[0])
+    return start[:n], dur[:n]
+
+
+def render(eng, state, path: str, max_lifetimes: int = 200):
+    cfg = eng.cfg
+    assert cfg.trace_ticks > 0, "run with Config.trace_ticks > 0"
+    T = min(int(np.asarray(state.tick).max()), cfg.trace_ticks)
+    series = {
+        "admitted": _series(state.stats, "arr_trace_admit", T),
+        "committed": _series(state.stats, "arr_trace_commit", T),
+        "aborted": _series(state.stats, "arr_trace_abort", T),
+        "waiting slots": _series(state.stats, "arr_trace_waiting", T),
+    }
+
+    start, dur = _lifetimes(state.stats)
+    k = min(max_lifetimes, start.shape[0])
+
+    fig, (ax1, ax2) = plt.subplots(2, 1, figsize=(8, 6), dpi=150,
+                                   height_ratios=[1, 1.2])
+    for name, ys in series.items():
+        ax1.plot(np.arange(T), ys, linewidth=2, label=name,
+                 color=SERIES[name])
+    style_axes(ax1, "tick", "count", "per-tick events")
+    ax1.legend(fontsize=7, frameon=False, ncol=4, labelcolor=INK)
+
+    order = np.argsort(start[:k])
+    for lane, i in enumerate(order):
+        ax2.plot([start[i], start[i] + dur[i]], [lane, lane],
+                 color=PALETTE[0], linewidth=1.2, solid_capstyle="butt")
+    style_axes(ax2, "tick", "committed txn (sample)",
+               "txn lifetimes: last restart -> commit")
+    fig.tight_layout()
+    fig.savefig(path)
+    plt.close(fig)
+    return path

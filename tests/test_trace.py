@@ -1,0 +1,70 @@
+"""Event-trace (DEBUG_TIMELINE analog) tests: the per-tick series must
+integrate to the run's totals, and lifetimes in the ring must match the
+latency stats."""
+
+import numpy as np
+
+from deneva_tpu.config import Config
+from deneva_tpu.engine.scheduler import Engine
+
+
+def run_traced(**kw):
+    base = dict(cc_alg="NO_WAIT", batch_size=128, synth_table_size=1 << 10,
+                req_per_query=4, zipf_theta=0.8, query_pool_size=1 << 10,
+                trace_ticks=64)
+    base.update(kw)
+    eng = Engine(Config(**base))
+    st = eng.run(40)
+    return eng, st
+
+
+def test_series_integrate_to_totals():
+    eng, st = run_traced()
+    s = eng.summary(st)
+    commits = np.asarray(st.stats["arr_trace_commit"])
+    aborts = np.asarray(st.stats["arr_trace_abort"])
+    admits = np.asarray(st.stats["arr_trace_admit"])
+    assert int(commits.sum()) == s["txn_cnt"]
+    assert int(aborts.sum()) == s["total_txn_abort_cnt"]
+    assert int(admits.sum()) == s["local_txn_start_cnt"]
+    # waiting series integrates to the cc-block latency integral
+    waiting = np.asarray(st.stats["arr_trace_waiting"])
+    assert float(waiting.sum()) == s["lat_cc_block_time"]
+
+
+def test_lifetimes_match_ring():
+    eng, st = run_traced()
+    n = min(int(np.asarray(st.stats["lat_ring_cursor"])),
+            st.stats["arr_lat_short"].shape[0])
+    assert n > 0
+    dur = np.asarray(st.stats["arr_lat_short"])[:n]
+    start = np.asarray(st.stats["arr_lat_start"])[:n]
+    assert (dur >= eng.cfg.req_per_query).all()     # faithful window
+    assert (start >= 0).all()
+    assert (start + dur <= int(np.asarray(st.tick))).all()
+
+
+def test_trace_off_carries_no_arrays():
+    eng, st = run_traced(trace_ticks=0)
+    assert "arr_trace_commit" not in st.stats
+    assert "arr_lat_start" not in st.stats
+
+
+def test_render_timeline(tmp_path):
+    from experiments.timeline_plot import render
+    eng, st = run_traced()
+    out = render(eng, st, str(tmp_path / "timeline.png"))
+    import os
+    assert os.path.getsize(out) > 10_000
+
+
+def test_sharded_trace():
+    from deneva_tpu.parallel.sharded import ShardedEngine
+    cfg = Config(cc_alg="WAIT_DIE", node_cnt=4, part_cnt=4, batch_size=32,
+                 synth_table_size=1 << 10, req_per_query=4, zipf_theta=0.6,
+                 query_pool_size=512, trace_ticks=32)
+    eng = ShardedEngine(cfg)
+    st = eng.run(25)
+    s = eng.summary(st)
+    commits = np.asarray(st.stats["arr_trace_commit"])  # (N, T)
+    assert int(commits.sum()) == s["txn_cnt"]
