@@ -61,6 +61,8 @@ class Timestamp(CCPlugin):
                 "rts": jnp.maximum(db["rts"] - shift, 0)}
 
     def access(self, cfg: Config, db: dict, txn: TxnState, active):
+        if cfg.sub_ticks > 1:
+            return self._access_subticked(cfg, db, txn, active)
         ent = make_entries(txn, active, window=cfg.acquire_window)
         n = ent.key.shape[0]
         wts_k = db["wts"][jnp.clip(ent.key, 0, db["wts"].shape[0] - 1)]
@@ -101,6 +103,75 @@ class Timestamp(CCPlugin):
         return (AccessDecision(grant=grant_e.reshape(B, R),
                                wait=wait_e.reshape(B, R),
                                abort=abort_e.reshape(B, R)),
+                {**db, "rts": rts})
+
+    def _access_subticked(self, cfg: Config, db: dict, txn: TxnState,
+                          active):
+        """K timestamp-ordered sub-rounds (Config.sub_ticks).
+
+        The only within-tick coupling the one-round kernel cannot express
+        is pending-prewrite WITHDRAWAL: a txn aborted by an earlier request
+        this tick still blocks readers behind its held prewrites until tick
+        end.  Sub-rounds remove dead txns' prewrites for later groups (and
+        add freshly granted ones), exactly the incremental state a
+        sequential ts-order interleaving sees.  The wts/rts decision inputs
+        are round-invariant: a granted read's rts bump can only exceed the
+        ts of LATER (larger-ts) writers, which it never aborts.
+        """
+        K = cfg.sub_ticks
+        B, R = txn.keys.shape
+        ridx = jnp.arange(R, dtype=jnp.int32)[None, :]
+        cur = txn.cursor[:, None]
+        req_base = active[:, None] & (ridx == cur) & (cur < txn.n_req[:, None])
+        held_base = active[:, None] & (ridx < cur)
+        ts_e = jnp.broadcast_to(txn.ts[:, None], (B, R))
+
+        n_rows = db["wts"].shape[0]
+        kclip = jnp.clip(txn.keys, 0, n_rows - 1)
+        wts_k = db["wts"][kclip]
+        rts_k = db["rts"][kclip]
+        if cfg.ts_twr:
+            w_abort = ts_e < rts_k
+        else:
+            w_abort = (ts_e < rts_k) | (ts_e < wts_k)
+        r_abort = ts_e < wts_k
+
+        from deneva_tpu.cc.twopl import ts_groups
+        group = ts_groups(txn.ts, active, K)
+
+        G = jnp.zeros((B, R), dtype=bool)
+        Wt = jnp.zeros((B, R), dtype=bool)
+        A = jnp.zeros((B, R), dtype=bool)
+        dead = jnp.zeros(B, dtype=bool)
+        flat = lambda x: x.reshape(-1)
+        n = B * R
+        for k in range(K):
+            grp = active & (group == k) & ~dead
+            req_m = req_base & grp[:, None]
+            held_m = (held_base | G) & ~dead[:, None]
+            live = held_m | req_m
+            key_f = jnp.where(flat(live), flat(txn.keys), NULL_KEY)
+            (skey, sts), (s_iw, s_held, s_req, s_wab, s_orig) = seg.sort_by(
+                (key_f, flat(ts_e)),
+                (flat(txn.is_write), flat(held_m), flat(req_m),
+                 flat(w_abort), jnp.arange(n, dtype=jnp.int32)))
+            starts = seg.segment_starts(skey)
+            s_live = skey != NULL_KEY
+            pending_w = s_live & s_iw & (s_held | (s_req & ~s_wab))
+            pw_before = seg.seg_any_before(pending_w, starts)
+            pw = jnp.zeros(n, dtype=bool).at[s_orig].set(
+                pw_before).reshape(B, R)
+
+            g = req_m & jnp.where(txn.is_write, ~w_abort,
+                                  ~r_abort & ~pw)
+            w = req_m & ~txn.is_write & ~r_abort & pw
+            a = req_m & ~g & ~w
+            G, Wt, A = G | g, Wt | w, A | a
+            dead = dead | a.any(axis=1)
+
+        rts = db["rts"].at[flat(txn.keys)].max(
+            jnp.where(flat(G & ~txn.is_write), flat(ts_e), 0), mode="drop")
+        return (AccessDecision(grant=G, wait=Wt, abort=A),
                 {**db, "rts": rts})
 
     def on_commit(self, cfg: Config, db: dict, txn: TxnState, committed,

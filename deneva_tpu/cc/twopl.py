@@ -290,6 +290,19 @@ def arbitrate_window(txn, active, policy: str, tmp: dict,
 # Sub-ticked arbitration — finer time quantization for parity
 # ---------------------------------------------------------------------------
 
+def ts_groups(ts, active, K: int):
+    """Contiguous timestamp groups for sub-round arbitration: rank live
+    txns by ts and split into K quantile groups (shared by the 2PL and
+    TIMESTAMP sub-tick kernels)."""
+    B = ts.shape[0]
+    tsk = jnp.where(active, ts, BIG_TS)
+    order = jnp.argsort(tsk)
+    rank = jnp.zeros(B, jnp.int32).at[order].set(
+        jnp.arange(B, dtype=jnp.int32))
+    n_act = jnp.maximum(jnp.sum(active.astype(jnp.int32)), 1)
+    return jnp.minimum(rank * K // n_act, K - 1)
+
+
 def arbitrate_subticked(txn, active, policy: str, K: int,
                         read_locks_held: bool = True):
     """Arbitrate one tick's requests in K timestamp-ordered sub-rounds.
@@ -316,12 +329,7 @@ def arbitrate_subticked(txn, active, policy: str, K: int,
     req_base = active[:, None] & (ridx == cur) & (cur < txn.n_req[:, None])
 
     # contiguous ts groups (ts unique among live txns)
-    tsk = jnp.where(active, txn.ts, BIG_TS)
-    order = jnp.argsort(tsk)
-    rank = jnp.zeros(B, jnp.int32).at[order].set(
-        jnp.arange(B, dtype=jnp.int32))
-    n_act = jnp.maximum(jnp.sum(active.astype(jnp.int32)), 1)
-    group = jnp.minimum(rank * K // n_act, K - 1)
+    group = ts_groups(txn.ts, active, K)
 
     G = jnp.zeros((B, R), dtype=bool)
     W = jnp.zeros((B, R), dtype=bool)

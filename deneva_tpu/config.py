@@ -220,10 +220,10 @@ class Config:
                 "commit_after_access applies to the single-shard engine; " \
                 "the sharded tick already arbitrates before committing"
         if self.sub_ticks > 1:
-            # only the 2PL family implements sub-round arbitration; fail
-            # loudly rather than silently running one round
-            assert self.cc_alg in (NO_WAIT, WAIT_DIE), \
-                "sub_ticks only refines NO_WAIT/WAIT_DIE arbitration"
+            # fail loudly where sub-round arbitration is not implemented
+            # rather than silently running one round
+            assert self.cc_alg in (NO_WAIT, WAIT_DIE, TIMESTAMP), \
+                "sub_ticks refines NO_WAIT/WAIT_DIE/TIMESTAMP arbitration"
             assert self.acquire_window == 1, "sub_ticks needs window=1"
         assert self.part_cnt >= self.node_cnt and self.part_cnt % self.node_cnt == 0
         assert self.synth_table_size % self.part_cnt == 0

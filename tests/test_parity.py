@@ -35,6 +35,41 @@ def test_abort_rate_parity(alg):
     assert 0.8 <= r["tput_ratio"] <= 1.25, r
 
 
+def test_timestamp_subticked_parity():
+    """TIMESTAMP's sub-round path (pending-prewrite withdrawal visible to
+    later groups) holds parity at high skew and conserves writes."""
+    r = run_pair(Config(cc_alg="TIMESTAMP", sub_ticks=8,
+                        **{**CFG, "zipf_theta": 0.9}), n_ticks=50)
+    assert r["batched_conserved"] and r["sequential_conserved"], r
+    assert r["abort_rate_divergence"] <= 0.02, r
+
+
+def test_tpcc_timestamp_mixed_cell_bounded():
+    """The one outstanding PARITY.md cell: the mixed-length TPC-C workload
+    under TIMESTAMP measures +5% +-2%; enforce it stays at that level
+    (a regression past ~3 sigma fails here)."""
+    cfg = Config(workload="TPCC", cc_alg="TIMESTAMP", batch_size=64,
+                 num_wh=4, cust_per_dist=1000, max_items=128,
+                 query_pool_size=1 << 10, warmup_ticks=0,
+                 synth_table_size=8)
+    r = run_pair(cfg, 50)
+    assert r["batched_conserved"] and r["sequential_conserved"], r
+    assert r["abort_rate_divergence"] <= 0.12, r
+
+
+def test_tpcc_pure_mix_cells_exact():
+    """The characterization behind PARITY.md's one outstanding cell:
+    pure-Payment and pure-NewOrder TIMESTAMP cells match the oracle
+    EXACTLY; only the mixed-length workload diverges."""
+    for pp in (1.0, 0.0):
+        cfg = Config(workload="TPCC", cc_alg="TIMESTAMP", perc_payment=pp,
+                     batch_size=64, num_wh=4, cust_per_dist=1000,
+                     max_items=128, query_pool_size=1 << 10,
+                     warmup_ticks=0, synth_table_size=8)
+        r = run_pair(cfg, 50)
+        assert r["abort_rate_divergence"] == 0.0, (pp, r)
+
+
 @pytest.mark.parametrize("alg", ["NO_WAIT", "WAIT_DIE"])
 def test_subticked_parity_converges(alg):
     """With K=8 timestamp sub-rounds the 2PL kernels match the sequential
