@@ -53,15 +53,20 @@ def run_cell(acquire_window: int, batch_size: int, admit_cap: int,
     # static — a different count would recompile inside the timed window)
     state = eng.run_compiled(n_ticks)
     state = eng.run_compiled(n_ticks, state)
-    committed_before = int(np.asarray(state.stats["txn_cnt"]))
-
-    t0 = time.perf_counter()
-    state = eng.run_compiled(n_ticks, state)
     jax.block_until_ready(state.stats["txn_cnt"])
-    dt = time.perf_counter() - t0
 
-    committed = int(np.asarray(state.stats["txn_cnt"])) - committed_before
-    return committed / dt
+    # median of 3 measured windows: the tunneled chip shows ~+-8%
+    # window-to-window variance under host load
+    tputs = []
+    for _ in range(3):
+        committed_before = int(np.asarray(state.stats["txn_cnt"]))
+        t0 = time.perf_counter()
+        state = eng.run_compiled(n_ticks, state)
+        jax.block_until_ready(state.stats["txn_cnt"])
+        dt = time.perf_counter() - t0
+        committed = int(np.asarray(state.stats["txn_cnt"])) - committed_before
+        tputs.append(committed / dt)
+    return float(np.median(tputs))
 
 
 def main():

@@ -40,9 +40,32 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from deneva_tpu.cc.base import AccessDecision, CCPlugin
+from deneva_tpu.cc.twopl import ts_groups
 from deneva_tpu.config import Config
 from deneva_tpu.engine.state import TxnState, make_entries, NULL_KEY
 from deneva_tpu.ops import segment as seg
+
+
+def _decide(key, ts, is_write, held, req, w_abort, r_abort):
+    """The per-request T/O decision over flat entry arrays: sorts by
+    (key, ts), finds the pending-prewrite prefix ("a write entry — held
+    prewrite, or prewrite granted earlier this tick — with smaller ts
+    exists on my key"), and applies the grant/wait/abort rules.  The one
+    shared body behind both the one-round and sub-ticked paths."""
+    n = key.shape[0]
+    (skey, sts), (s_iw, s_held, s_req, s_wab, s_orig) = seg.sort_by(
+        (key, ts),
+        (is_write, held, req, w_abort, jnp.arange(n, dtype=jnp.int32)))
+    starts = seg.segment_starts(skey)
+    live = skey != NULL_KEY
+    pending_w = live & s_iw & (s_held | (s_req & ~s_wab))
+    pw_before = seg.seg_any_before(pending_w, starts)
+    pw = jnp.zeros(n, dtype=bool).at[s_orig].set(pw_before)
+
+    grant = req & jnp.where(is_write, ~w_abort, ~r_abort & ~pw)
+    wait = req & ~is_write & ~r_abort & pw
+    abort = req & ~grant & ~wait
+    return grant, wait, abort
 
 
 class Timestamp(CCPlugin):
@@ -64,7 +87,6 @@ class Timestamp(CCPlugin):
         if cfg.sub_ticks > 1:
             return self._access_subticked(cfg, db, txn, active)
         ent = make_entries(txn, active, window=cfg.acquire_window)
-        n = ent.key.shape[0]
         wts_k = db["wts"][jnp.clip(ent.key, 0, db["wts"].shape[0] - 1)]
         rts_k = db["rts"][jnp.clip(ent.key, 0, db["rts"].shape[0] - 1)]
 
@@ -75,25 +97,9 @@ class Timestamp(CCPlugin):
             w_abort = (ent.ts < rts_k) | (ent.ts < wts_k)
         r_abort = ent.ts < wts_k
 
-        # pending-prewrite rule needs ts-ordered prefix info per row segment:
-        # "a write entry (held prewrite, or prewrite granted earlier this
-        # tick) with smaller ts exists on my key"
-        (skey, sts), (s_iw, s_held, s_req, s_wab, s_orig) = seg.sort_by(
-            (ent.key, ent.ts),
-            (ent.is_write, ent.held, ent.req, w_abort,
-             jnp.arange(n, dtype=jnp.int32)),
-        )
-        starts = seg.segment_starts(skey)
-        live = skey != NULL_KEY
-        pending_w = live & s_iw & (s_held | (s_req & ~s_wab))
-        pw_before = seg.seg_any_before(pending_w, starts)
-        unsort = lambda x: jnp.zeros_like(x).at[s_orig].set(x)
-        pw_before = unsort(pw_before)
-
-        grant_e = ent.req & jnp.where(ent.is_write, ~w_abort,
-                                      ~r_abort & ~pw_before)
-        wait_e = ent.req & ~ent.is_write & ~r_abort & pw_before
-        abort_e = ent.req & ~grant_e & ~wait_e
+        grant_e, wait_e, abort_e = _decide(
+            ent.key, ent.ts, ent.is_write, ent.held, ent.req,
+            w_abort, r_abort)
 
         # granted reads advance rts immediately (row_ts.cpp:187-189)
         rts = db["rts"].at[ent.key].max(
@@ -136,7 +142,6 @@ class Timestamp(CCPlugin):
             w_abort = (ts_e < rts_k) | (ts_e < wts_k)
         r_abort = ts_e < wts_k
 
-        from deneva_tpu.cc.twopl import ts_groups
         group = ts_groups(txn.ts, active, K)
 
         G = jnp.zeros((B, R), dtype=bool)
@@ -151,21 +156,10 @@ class Timestamp(CCPlugin):
             held_m = (held_base | G) & ~dead[:, None]
             live = held_m | req_m
             key_f = jnp.where(flat(live), flat(txn.keys), NULL_KEY)
-            (skey, sts), (s_iw, s_held, s_req, s_wab, s_orig) = seg.sort_by(
-                (key_f, flat(ts_e)),
-                (flat(txn.is_write), flat(held_m), flat(req_m),
-                 flat(w_abort), jnp.arange(n, dtype=jnp.int32)))
-            starts = seg.segment_starts(skey)
-            s_live = skey != NULL_KEY
-            pending_w = s_live & s_iw & (s_held | (s_req & ~s_wab))
-            pw_before = seg.seg_any_before(pending_w, starts)
-            pw = jnp.zeros(n, dtype=bool).at[s_orig].set(
-                pw_before).reshape(B, R)
-
-            g = req_m & jnp.where(txn.is_write, ~w_abort,
-                                  ~r_abort & ~pw)
-            w = req_m & ~txn.is_write & ~r_abort & pw
-            a = req_m & ~g & ~w
+            g, w, a = _decide(key_f, flat(ts_e), flat(txn.is_write),
+                              flat(held_m), flat(req_m), flat(w_abort),
+                              flat(r_abort))
+            g, w, a = (g.reshape(B, R), w.reshape(B, R), a.reshape(B, R))
             G, Wt, A = G | g, Wt | w, A | a
             dead = dead | a.any(axis=1)
 
