@@ -33,10 +33,13 @@ from deneva_tpu import cc as cc_registry
 from deneva_tpu import workloads as wl_registry
 from deneva_tpu.config import Config
 from deneva_tpu.engine.state import (
-    STATUS_BACKOFF, STATUS_FREE, STATUS_RUNNING, STATUS_WAITING,
+    NULL_KEY, STATUS_BACKOFF, STATUS_FREE, STATUS_RUNNING, STATUS_WAITING,
     TxnState,
 )
 from deneva_tpu.workloads.base import QueryPool
+
+#: scatter sentinel: out-of-bounds row index, dropped by mode="drop"
+NULL_ROW = NULL_KEY
 
 
 class EngineState(NamedTuple):
@@ -80,11 +83,21 @@ STAT_KEYS_F32 = (
 LAT_SAMPLES = 1 << 14
 
 
-def _zeros_stats(cfg: Config | None = None) -> dict:
+def _zeros_stats(cfg: Config | None = None,
+                 wr_ring_shape: tuple[int, int] | None = None) -> dict:
     s = {k: jnp.zeros((), jnp.int32) for k in STAT_KEYS_I32}
     s.update({k: jnp.zeros((), jnp.float32) for k in STAT_KEYS_F32})
     s["arr_lat_short"] = jnp.zeros(LAT_SAMPLES, jnp.int32)
     s["lat_ring_cursor"] = jnp.zeros((), jnp.int32)
+    if wr_ring_shape is not None:
+        # committed-write buffer (see commit_block: the (n_rows,) scatter
+        # is deferred out of the hot tick; flushed by cond when filling
+        # past 3/4 and at every run() boundary).  Shape (4B, R): one ROW
+        # per committed txn — a B-row scatter vectorizes where the
+        # equivalent B*R-point scatter is latency-bound (PROFILE.md).
+        B, R = wr_ring_shape
+        s["arr_wr_ring"] = jnp.full((4 * B, R), NULL_ROW, jnp.int32)
+        s["wr_ring_cursor"] = jnp.zeros((), jnp.int32)
     if cfg is not None and cfg.trace_ticks > 0:
         # per-tick event series (DEBUG_TIMELINE analog, scripts/timeline.py)
         for k in ("arr_trace_admit", "arr_trace_commit", "arr_trace_abort",
@@ -381,12 +394,38 @@ def make_tick(cfg: Config, plugin, pool_dev: dict, workload=None):
 
             wmask = commit[:, None] & txn.is_write \
                 & (ridx < txn.n_req[:, None])
-            if apply_writes:
+            if apply_writes and "arr_wr_ring" in stats:
+                # append committed write keys to the write buffer instead of
+                # scattering into the (n_rows,) table here: an in-loop
+                # scatter into the 16M-row array makes XLA round-trip the
+                # whole 64 MB table through scoped memory every tick
+                # (~0.8 ms); the buffer is flushed by the cond at tick end
+                # and at run() boundaries (increments are blind writes —
+                # nothing reads `data` mid-run, so flush timing is
+                # invisible; the reference also applies at commit,
+                # storage/row.cpp:351-420).  One ring ROW per commit, at
+                # its commit rank: a row scatter with unique indices
+                # vectorizes; the dead-lane index is cap+lane so indices
+                # stay unique (dropped either way).
+                ring = stats["arr_wr_ring"]
+                writing = commit & jnp.any(wmask, axis=1)
+                wrank = jnp.cumsum(writing.astype(jnp.int32)) \
+                    - writing.astype(jnp.int32)
+                rowpos = jnp.where(writing, stats["wr_ring_cursor"] + wrank,
+                                   ring.shape[0]
+                                   + jnp.arange(txn.B, dtype=jnp.int32))
+                payload = jnp.where(wmask, txn.keys, NULL_ROW)
+                stats = {**stats,
+                         "arr_wr_ring": ring.at[rowpos].set(
+                             payload, mode="drop", unique_indices=True),
+                         "wr_ring_cursor": stats["wr_ring_cursor"]
+                         + jnp.sum(writing.astype(jnp.int32))}
+            elif apply_writes:
                 # dead lanes scatter to an out-of-bounds index and drop
                 # (adding 0 at a real key would serialize on hot rows)
                 data = data.at[jnp.where(
-                    wmask, txn.keys,
-                    jnp.int32(2**31 - 1)).reshape(-1)].add(1, mode="drop")
+                    wmask, txn.keys, NULL_ROW).reshape(-1)].add(
+                        1, mode="drop")
 
             if cfg.logging:
                 tid_e = jnp.broadcast_to(txn.pool_idx[:, None],
@@ -555,6 +594,23 @@ def make_tick(cfg: Config, plugin, pool_dev: dict, workload=None):
             ts_counter > REBASE_AT, _rebase, lambda op: op,
             (txn, db, ts_counter))
 
+        # cond-flush the write buffer at 3/4 occupancy (the scatter into
+        # the full (n_rows,) table runs only once per ~hundreds of ticks)
+        if apply_writes and "arr_wr_ring" in stats:
+            ring = stats["arr_wr_ring"]
+            need = stats["wr_ring_cursor"] > ring.shape[0] - txn.B
+
+            def _flush(op):
+                d, r = op
+                return (d.at[r.reshape(-1)].add(1, mode="drop"),
+                        jnp.full_like(r, NULL_ROW))
+
+            data, ring = jax.lax.cond(need, _flush, lambda op: op,
+                                      (data, ring))
+            stats = {**stats, "arr_wr_ring": ring,
+                     "wr_ring_cursor": jnp.where(
+                         need, 0, stats["wr_ring_cursor"])}
+
         stats = bump(stats, "measured_ticks", 1, measuring)
         return EngineState(txn=txn, db=db, data=data, tables=tables,
                            stats=stats, tick=t + 1,
@@ -584,6 +640,7 @@ class Engine:
         self._tick_jit = jax.jit(self._tick_fn, donate_argnums=0)
 
     def init_state(self) -> EngineState:
+        from deneva_tpu.config import MODE_NOCC, MODE_NORMAL
         cfg = self.cfg
         B, R = cfg.batch_size, self.pool.max_req
         return EngineState(
@@ -591,7 +648,8 @@ class Engine:
             db=self.plugin.init_db(cfg, self.n_rows, B, R),
             data=jnp.zeros(self.n_rows, jnp.int32),
             tables=self.workload.init_tables(cfg, 0),
-            stats=_zeros_stats(cfg),
+            stats=_zeros_stats(cfg, wr_ring_shape=(
+                (B, R) if cfg.mode in (MODE_NORMAL, MODE_NOCC) else None)),
             tick=jnp.zeros((), jnp.int32),
             pool_cursor=jnp.zeros((), jnp.int32),
             ts_counter=jnp.ones((), jnp.int32),
@@ -608,11 +666,29 @@ class Engine:
             state = self._tick_jit(state)
             if prog_every and (i + 1) % prog_every == 0:
                 print(self.summary_line(state, prog=True), flush=True)
-        return state
+        return self._flush_writes(state)
 
     @functools.partial(jax.jit, static_argnums=(0, 1), donate_argnums=2)
     def _run_scan(self, n_ticks: int, state: EngineState) -> EngineState:
-        return jax.lax.fori_loop(0, n_ticks, lambda _, s: self._tick_fn(s), state)
+        out = jax.lax.fori_loop(0, n_ticks, lambda _, s: self._tick_fn(s),
+                                state)
+        return self._flush_body(out)
+
+    @functools.partial(jax.jit, static_argnums=0, donate_argnums=1)
+    def _flush_writes(self, state: EngineState) -> EngineState:
+        return self._flush_body(state)
+
+    def _flush_body(self, state: EngineState) -> EngineState:
+        """Apply the deferred committed-write buffer to the data table so
+        host readers (tests, summaries) always see it up to date."""
+        if "arr_wr_ring" not in state.stats:
+            return state
+        ring = state.stats["arr_wr_ring"]
+        data = state.data.at[ring.reshape(-1)].add(1, mode="drop")
+        stats = {**state.stats,
+                 "arr_wr_ring": jnp.full_like(ring, NULL_ROW),
+                 "wr_ring_cursor": jnp.zeros((), jnp.int32)}
+        return state._replace(data=data, stats=stats)
 
     def run_compiled(self, n_ticks: int, state: EngineState | None = None) -> EngineState:
         """Fully device-side run: n_ticks in one lax.fori_loop under jit."""
@@ -624,7 +700,7 @@ class Engine:
         """Host-side stats in the reference's [summary] vocabulary
         (statistics/stats.cpp:1541-1575)."""
         s = {k: np.asarray(v).item() for k, v in state.stats.items()
-             if not k.startswith("arr_")}
+             if not k.startswith("arr_") and k != "wr_ring_cursor"}
         commits = max(s["txn_cnt"], 1)
         out = dict(s)
         out["tput_per_tick"] = s["txn_cnt"] / max(s["measured_ticks"], 1)

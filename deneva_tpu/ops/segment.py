@@ -16,7 +16,6 @@ required, so cost scales with B*R (live access entries), not table size.
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -46,7 +45,7 @@ def start_index(starts: jnp.ndarray) -> jnp.ndarray:
 
 
 def seg_ids(starts: jnp.ndarray) -> jnp.ndarray:
-    """Dense 0-based segment ids (for jax.ops.segment_* reductions)."""
+    """Dense 0-based segment ids of each equal-id run."""
     return jnp.cumsum(starts.astype(jnp.int32)) - 1
 
 
@@ -57,11 +56,19 @@ def pos_in_segment(starts: jnp.ndarray) -> jnp.ndarray:
 
 
 def seg_cumsum_exclusive(x: jnp.ndarray, starts: jnp.ndarray) -> jnp.ndarray:
-    """Per-segment exclusive prefix sum (count of `x` strictly before me)."""
+    """Per-segment exclusive prefix sum (count of `x` strictly before me).
+
+    Requires x >= 0.  The value at my segment start is recovered WITHOUT a
+    gather: `excl` is non-decreasing (cumsum of non-negatives), so the excl
+    value at the last segment start at-or-before me is
+    ``cummax(where(starts, excl, 0))`` — gathers into entry-sized arrays
+    cost ~0.6 ms per 80k lanes on TPU (PROFILE.md) while the cummax is a
+    cheap two-level reduce-window.
+    """
     cs = jnp.cumsum(x, axis=0)
-    excl = cs - x  # global exclusive cumsum
-    s = start_index(starts)
-    return excl - excl[s]
+    excl = cs - x  # global exclusive cumsum, non-decreasing
+    start_excl = lax.cummax(jnp.where(starts, excl, 0), axis=0)
+    return excl - start_excl
 
 
 def seg_any_before(mask: jnp.ndarray, starts: jnp.ndarray) -> jnp.ndarray:
@@ -72,22 +79,31 @@ def seg_any_before(mask: jnp.ndarray, starts: jnp.ndarray) -> jnp.ndarray:
 def seg_reduce(vals: jnp.ndarray, starts: jnp.ndarray, op: str) -> jnp.ndarray:
     """Whole-segment reduction broadcast back to every member.
 
-    op in {"min", "max", "sum"}.  Uses dense segment ids + scatter; the number
-    of segments is bounded by the array length (static shape).  Every segment
-    id present has at least one member by construction, so no empty-segment
-    fill value is needed.
+    op in {"min", "max", "sum"}.  Combined from an exclusive-prefix and an
+    exclusive-suffix segmented scan: on TPU the alternative
+    (``jax.ops.segment_*`` scatter + gather back at the segment ids) pays
+    two latency-bound dynamic-index ops per call, while the scans are
+    log-depth elementwise passes (PROFILE.md cost model).
     """
-    ids = seg_ids(starts)
-    n = vals.shape[0]
     if op == "min":
-        tot = jax.ops.segment_min(vals, ids, num_segments=n)
+        big = jnp.iinfo(vals.dtype).max if jnp.issubdtype(
+            vals.dtype, jnp.integer) else jnp.inf
+        pre = _seg_scan(vals, starts, jnp.minimum, big)
+        suf = seg_suffix_min(vals, starts, big)
+        return jnp.minimum(jnp.minimum(pre, vals), suf)
     elif op == "max":
-        tot = jax.ops.segment_max(vals, ids, num_segments=n)
+        small = jnp.iinfo(vals.dtype).min if jnp.issubdtype(
+            vals.dtype, jnp.integer) else -jnp.inf
+        pre = _seg_scan(vals, starts, jnp.maximum, small)
+        suf = seg_suffix_max(vals, starts, small)
+        return jnp.maximum(jnp.maximum(pre, vals), suf)
     elif op == "sum":
-        tot = jax.ops.segment_sum(vals, ids, num_segments=n)
+        pre = _seg_scan(vals, starts, jnp.add, jnp.zeros((), vals.dtype))
+        suf = _seg_suffix_scan(vals, starts, jnp.add,
+                               jnp.zeros((), vals.dtype))
+        return pre + vals + suf
     else:  # pragma: no cover
         raise ValueError(op)
-    return tot[ids]
 
 
 def seg_min_where(vals: jnp.ndarray, where: jnp.ndarray, starts: jnp.ndarray,
@@ -154,17 +170,19 @@ def _seg_ends(starts: jnp.ndarray) -> jnp.ndarray:
     return jnp.roll(starts, -1).at[-1].set(True)
 
 
+def _seg_suffix_scan(vals: jnp.ndarray, starts: jnp.ndarray, op, identity):
+    """Exclusive per-segment suffix scan with combine `op` (associative)."""
+    rev = lambda x: x[::-1]
+    return rev(_seg_scan(rev(vals), rev(_seg_ends(starts)), op, identity))
+
+
 def seg_suffix_min(vals: jnp.ndarray, starts: jnp.ndarray,
                    identity: int) -> jnp.ndarray:
     """Min over elements strictly after me in my segment (identity if none)."""
-    rev = lambda x: x[::-1]
-    return rev(_seg_scan(rev(vals), rev(_seg_ends(starts)),
-                         jnp.minimum, identity))
+    return _seg_suffix_scan(vals, starts, jnp.minimum, identity)
 
 
 def seg_suffix_max(vals: jnp.ndarray, starts: jnp.ndarray,
                    identity: int = 0) -> jnp.ndarray:
     """Max over elements strictly after me in my segment (identity if none)."""
-    rev = lambda x: x[::-1]
-    return rev(_seg_scan(rev(vals), rev(_seg_ends(starts)),
-                         jnp.maximum, identity))
+    return _seg_suffix_scan(vals, starts, jnp.maximum, identity)

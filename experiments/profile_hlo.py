@@ -1,0 +1,103 @@
+"""Per-HLO-op time breakdown of an engine run from a jax.profiler trace.
+
+Captures a trace of `run_compiled` on the current default device, parses the
+xplane protobuf (via tensorflow's bundled xplane_pb2 — the plugin's converter
+is version-incompatible here), and prints the top ops by total self-time,
+aggregated by HLO op name and by category.
+
+Usage:
+  python experiments/profile_hlo.py [--mode NORMAL] [--batch 8192] [--top 40]
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import os
+import re
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
+
+
+def capture(batch: int, mode: str, ticks: int = 200) -> str:
+    import jax
+    from deneva_tpu.config import Config
+    from deneva_tpu.engine.scheduler import Engine
+
+    cfg = Config(cc_alg="NO_WAIT", batch_size=batch,
+                 synth_table_size=1 << 24, req_per_query=10, zipf_theta=0.6,
+                 tup_read_perc=0.5, query_pool_size=1 << 16, warmup_ticks=0,
+                 backoff=True, acquire_window=1, admit_cap=1024, mode=mode)
+    eng = Engine(cfg)
+    st = eng.run_compiled(ticks)
+    st = eng.run_compiled(ticks, st)
+    jax.block_until_ready(st.stats["txn_cnt"])
+    tdir = tempfile.mkdtemp(prefix="hloprof")
+    with jax.profiler.trace(tdir):
+        st = eng.run_compiled(ticks, st)
+        jax.block_until_ready(st.stats["txn_cnt"])
+    pbs = glob.glob(os.path.join(tdir, "**", "*.xplane.pb"), recursive=True)
+    assert pbs, f"no trace written under {tdir}"
+    return pbs[0]
+
+
+#: leading fusion-instance counters etc.: "fusion.123" -> "fusion"
+_NAME_RE = re.compile(r"^([a-zA-Z-_]+)")
+
+
+def op_table(pb_path: str, ticks: int):
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    xs = xplane_pb2.XSpace()
+    with open(pb_path, "rb") as f:
+        xs.ParseFromString(f.read())
+
+    by_op = collections.Counter()
+    occ = collections.Counter()
+    total_ps = 0
+    for plane in xs.planes:
+        if "TPU" not in plane.name and "/device" not in plane.name.lower():
+            continue
+        metas = {m.id: m.name for m in plane.event_metadata.values()} if \
+            isinstance(plane.event_metadata, dict) else \
+            {mid: m.name for mid, m in plane.event_metadata.items()}
+        for line in plane.lines:
+            if "XLA Ops" not in line.name and "xla op" not in \
+                    line.name.lower():
+                continue
+            for ev in line.events:
+                name = metas.get(ev.metadata_id, str(ev.metadata_id))
+                m = _NAME_RE.match(name)
+                key = m.group(1) if m else name
+                by_op[key] += ev.duration_ps
+                occ[key] += 1
+                total_ps += ev.duration_ps
+    return by_op, occ, total_ps
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mode", default="NORMAL")
+    ap.add_argument("--batch", type=int, default=8192)
+    ap.add_argument("--top", type=int, default=40)
+    ap.add_argument("--ticks", type=int, default=200)
+    ap.add_argument("--pb", help="parse an existing .xplane.pb instead")
+    args = ap.parse_args()
+
+    pb = args.pb or capture(args.batch, args.mode, args.ticks)
+    by_op, occ, total_ps = op_table(pb, args.ticks)
+    print(f"# {pb}")
+    print(f"total device op-time: {total_ps/1e9:.3f} ms over {args.ticks} "
+          f"ticks = {total_ps/1e9/args.ticks:.4f} ms/tick")
+    print(f"{'op':<40} {'ms/tick':>9} {'%':>6} {'count':>8}")
+    for op, ps in by_op.most_common(args.top):
+        print(f"{op:<40} {ps/1e9/args.ticks:>9.4f} "
+              f"{100*ps/max(total_ps,1):>6.1f} {occ[op]:>8}")
+
+
+if __name__ == "__main__":
+    main()

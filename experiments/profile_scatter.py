@@ -1,0 +1,104 @@
+"""Scatter/gather cost matrix on the real chip — what sets the price?
+
+Sweeps update-lane count x target-array size x (add vs set) x
+(unique_indices/indices_are_sorted hints) for scatter, and index count x
+source size for gather.  Each op runs in a 200-iteration device loop with a
+live dependence; reports ms/iter.
+
+Usage: python experiments/profile_scatter.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+ITERS = 200
+
+
+def _time_loop(body, state):
+    fn = jax.jit(lambda s: jax.lax.fori_loop(0, ITERS, lambda _, x: body(x),
+                                             s))
+    out = fn(state)
+    jax.block_until_ready(out)
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = fn(state)
+        jax.block_until_ready(out)
+        ts.append((time.perf_counter() - t0) / ITERS * 1e3)
+    return float(np.median(ts))
+
+
+def scatter_ms(lanes, target, op="add", unique=False, srt=False,
+               mask_frac=1.0):
+    rng = np.random.default_rng(0)
+    if unique:
+        idx = rng.choice(target, size=lanes, replace=False).astype(np.int32)
+    else:
+        idx = rng.integers(0, target, lanes).astype(np.int32)
+    if srt:
+        idx = np.sort(idx)
+    if mask_frac < 1.0:
+        dead = rng.random(lanes) >= mask_frac
+        idx = np.where(dead, np.int32(2**31 - 1), idx)
+    idxj = jnp.asarray(idx)
+
+    def body(data):
+        upd = jnp.full(lanes, 1, jnp.int32) + data[0]
+        ref = data.at[idxj]
+        kw = dict(mode="drop", unique_indices=unique,
+                  indices_are_sorted=srt)
+        return ref.add(upd, **kw) if op == "add" else ref.set(upd, **kw)
+
+    return _time_loop(body, jnp.zeros(target, jnp.int32))
+
+
+def gather_ms(lanes, source, srt=False):
+    rng = np.random.default_rng(0)
+    idx = rng.integers(0, source, lanes).astype(np.int32)
+    if srt:
+        idx = np.sort(idx)
+    idxj = jnp.asarray(idx)
+    src = jnp.asarray(rng.integers(0, 100, source).astype(np.int32))
+
+    def body(acc):
+        vals = src[(idxj + acc[0]) % source]
+        return acc + vals[:1]
+
+    return _time_loop(body, jnp.zeros(1, jnp.int32))
+
+
+def main():
+    print("scatter (ms/iter):")
+    print(f"{'lanes':>7} {'target':>9} {'op':>4} {'uniq':>5} {'sort':>5} "
+          f"{'ms':>8}")
+    for lanes in (8192, 81920):
+        for target in (1 << 16, 1 << 20, 1 << 24):
+            for op in ("add", "set"):
+                for unique, srt in ((False, False), (True, False),
+                                    (True, True)):
+                    if unique and lanes > target:
+                        continue
+                    ms = scatter_ms(lanes, target, op, unique, srt)
+                    print(f"{lanes:>7} {target:>9} {op:>4} {unique!s:>5} "
+                          f"{srt!s:>5} {ms:>8.3f}", flush=True)
+    print("\ngather (ms/iter):")
+    print(f"{'lanes':>7} {'source':>9} {'sort':>5} {'ms':>8}")
+    for lanes in (1024, 8192, 81920):
+        for source in (1 << 16, 1 << 20, 1 << 24):
+            for srt in (False, True):
+                ms = gather_ms(lanes, source, srt)
+                print(f"{lanes:>7} {source:>9} {srt!s:>5} {ms:>8.3f}",
+                      flush=True)
+
+
+if __name__ == "__main__":
+    main()

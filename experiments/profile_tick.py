@@ -1,0 +1,140 @@
+"""Decompose the faithful-cell tick cost on the real chip (PROFILE.md data).
+
+Times, for a range of batch sizes on the headline cell (YCSB NO_WAIT,
+zipf 0.6, 50/50 rw, 16M rows, R=10, acquire_window=1):
+
+  - the full tick (mode NORMAL),
+  - the tick with CC disabled (mode NOCC: no arbitration kernel),
+  - the bare ``arbitrate`` kernel on matching shapes,
+  - the bare 3-operand ``lax.sort`` that dominates it,
+  - the commit write-apply scatter alone.
+
+Each measurement runs the target in a 200-iteration device-side
+``lax.fori_loop`` with a live data dependence and reports ms/iteration
+(median of 3 windows after one discarded warmup dispatch).
+
+Usage: python experiments/profile_tick.py [B ...]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deneva_tpu.config import Config
+from deneva_tpu.engine.scheduler import Engine
+from deneva_tpu.engine.state import Entries
+from deneva_tpu.cc import twopl
+
+ITERS = 200
+
+
+def _time_loop(body, state):
+    """ms per iteration of body in a fori_loop (median of 3 windows)."""
+    fn = jax.jit(lambda s: jax.lax.fori_loop(0, ITERS, lambda _, x: body(x),
+                                             s))
+    out = fn(state)
+    jax.block_until_ready(out)
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = fn(state)
+        jax.block_until_ready(out)
+        ts.append((time.perf_counter() - t0) / ITERS * 1e3)
+    return float(np.median(ts))
+
+
+def cell_cfg(B, window=1, mode="NORMAL"):
+    return Config(cc_alg="NO_WAIT", batch_size=B, synth_table_size=1 << 24,
+                  req_per_query=10, zipf_theta=0.6, tup_read_perc=0.5,
+                  query_pool_size=1 << 16, warmup_ticks=0, backoff=True,
+                  acquire_window=window, admit_cap=1024, mode=mode)
+
+
+def time_engine(B, mode="NORMAL"):
+    eng = Engine(cell_cfg(B, mode=mode))
+    st = eng.run_compiled(ITERS)          # reach steady-state occupancy
+    st = eng.run_compiled(ITERS, st)
+    jax.block_until_ready(st.stats["txn_cnt"])
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        st = eng.run_compiled(ITERS, st)
+        jax.block_until_ready(st.stats["txn_cnt"])
+        ts.append((time.perf_counter() - t0) / ITERS * 1e3)
+    committed = st.stats["txn_cnt"]
+    return float(np.median(ts)), eng, st
+
+
+def time_arbitrate(B, R=10):
+    """Bare NO_WAIT arbitration on a synthetic steady-state entry mix."""
+    rng = np.random.default_rng(0)
+    n = B * R
+    keys = rng.zipf(1.6, n).astype(np.int32) % (1 << 24)
+    held = rng.random(n) < 0.35
+    req = ~held & (rng.random(n) < 0.12)
+    ent = Entries(
+        key=jnp.asarray(keys),
+        txn=jnp.asarray(np.repeat(np.arange(B, dtype=np.int32), R)),
+        ridx=jnp.asarray(np.tile(np.arange(R, dtype=np.int32), B)),
+        ts=jnp.asarray(rng.permutation(n).astype(np.int32) + 1),
+        is_write=jnp.asarray(rng.random(n) < 0.5),
+        held=jnp.asarray(held), req=jnp.asarray(req))
+
+    def body(ts):
+        g, w, a = twopl.arbitrate(ent._replace(ts=ts), "NO_WAIT")
+        return ts + g.astype(jnp.int32) - a.astype(jnp.int32)
+
+    return _time_loop(body, ent.ts)
+
+
+def time_sort(B, R=10, operands=3, num_keys=2):
+    n = B * R
+    rng = np.random.default_rng(0)
+    arrs = [jnp.asarray(rng.integers(0, 1 << 30, n).astype(np.int32))
+            for _ in range(operands)]
+
+    def body(a0):
+        out = jax.lax.sort((a0, *arrs[1:]), num_keys=num_keys,
+                           is_stable=False)
+        return out[0]
+
+    return _time_loop(body, arrs[0])
+
+
+def time_write_scatter(B, R=10, n_rows=1 << 24):
+    rng = np.random.default_rng(0)
+    keys = jnp.asarray(rng.zipf(1.6, B * R).astype(np.int32) % n_rows)
+    mask = jnp.asarray(rng.random(B * R) < 0.02)
+
+    def body(data):
+        idx = jnp.where(mask & (data[0] >= 0), keys, jnp.int32(2**31 - 1))
+        return data.at[idx].add(1, mode="drop")
+
+    return _time_loop(body, jnp.zeros(n_rows, jnp.int32))
+
+
+def main():
+    Bs = [int(a) for a in sys.argv[1:]] or [2048, 4096, 8192, 16384]
+    print(f"{'B':>6} {'tick':>7} {'nocc':>7} {'arb':>7} {'sort3':>7} "
+          f"{'sort1':>7} {'wscat':>7}  (ms)")
+    for B in Bs:
+        tick, eng, st = time_engine(B)
+        nocc, _, _ = time_engine(B, mode="NOCC")
+        arb = time_arbitrate(B)
+        s3 = time_sort(B, operands=3, num_keys=2)
+        s1 = time_sort(B, operands=1, num_keys=1)
+        ws = time_write_scatter(B)
+        print(f"{B:>6} {tick:>7.3f} {nocc:>7.3f} {arb:>7.3f} {s3:>7.3f} "
+              f"{s1:>7.3f} {ws:>7.3f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
