@@ -49,7 +49,8 @@ import jax.numpy as jnp
 from deneva_tpu.cc.base import AccessDecision, CCPlugin
 from deneva_tpu.config import Config
 from deneva_tpu.engine.state import (BIG_TS, NULL_KEY, STATUS_RUNNING,
-                                     STATUS_WAITING, TxnState, make_entries)
+                                     STATUS_WAITING, TxnState, make_entries,
+                                     request_window)
 from deneva_tpu.ops import segment as seg
 
 
@@ -88,12 +89,15 @@ class Maat(CCPlugin):
         ent = make_entries(txn, active, window=cfg.acquire_window)
         req = ent.req.reshape(B, R)
         n_rows = db["maat_lr"].shape[0]
-        k = jnp.clip(txn.keys, 0, n_rows - 1)
 
         # snapshot greatest last-write/last-read over this tick's granted
-        # accesses (row_maat.cpp:131-136,183-189); everything is granted
-        lw_k = jnp.where(req, db["maat_lw"][k], 0)
-        lr_k = jnp.where(req & txn.is_write, db["maat_lr"][k], 0)
+        # accesses (row_maat.cpp:131-136,183-189); everything is granted.
+        # Row state is gathered at the REQUEST lanes only (B*W, not B*R).
+        rkey, riw, valid = request_window(txn, active, cfg.acquire_window)
+        kw = jnp.clip(rkey, 0, n_rows - 1).reshape(-1)
+        shape = rkey.shape
+        lw_k = jnp.where(valid, db["maat_lw"][kw].reshape(shape), 0)
+        lr_k = jnp.where(valid & riw, db["maat_lr"][kw].reshape(shape), 0)
         gw = jnp.maximum(db["maat_gw"], lw_k.max(axis=1))
         gr = jnp.maximum(db["maat_gr"], lr_k.max(axis=1))
 
@@ -119,14 +123,48 @@ class Maat(CCPlugin):
         tx = jnp.broadcast_to(
             jnp.arange(B, dtype=jnp.int32)[:, None], (B, R)).reshape(-1)
 
-        (skey, sts), (s_iw, s_fin, s_tx) = seg.sort_by(
-            (key, ts), (iw, fin_e, tx))
+        orig = jnp.arange(n, dtype=jnp.int32)
+        (skey, sts), (s_iw, s_fin, s_tx, s_orig) = seg.sort_by(
+            (key, ts), (iw, fin_e, tx, orig))
         starts = seg.segment_starts(skey)
 
         # saturating +-1 (the reference pins at 0 / UINT64_MAX,
         # maat.cpp:57-62,81-86; int32 wraparound would erase the push)
         up1 = lambda v: jnp.minimum(v, BIG_TS - 1) + 1
         dn1 = lambda v: jnp.maximum(v, 1) - 1
+
+        def to_sorted(*vals_B):
+            """Broadcast per-txn (B,) values to entries and permute into
+            this sort's order by re-sorting on the same fixed keys — on
+            TPU one extra sort is ~4x cheaper than the per-lane
+            valid[s_tx]-style gathers it replaces (PROFILE.md)."""
+            pay = tuple(jnp.broadcast_to(v[:, None].astype(jnp.int32),
+                                         (B, R)).reshape(-1)
+                        for v in vals_B)
+            out = jax.lax.sort((key, ts) + pay, num_keys=2, is_stable=False)
+            return out[2:]
+
+        def txn_reduce(perm, sorted_val, op):
+            """Per-txn reduction over sorted entries: sort back to entry
+            order on the given original-index permutation, reduce over the
+            R lanes."""
+            _, v = jax.lax.sort((perm, sorted_val), num_keys=1,
+                                is_stable=False)
+            v = v.reshape(B, R)
+            return v.min(axis=1) if op == "min" else v.max(axis=1)
+
+        def run_start_bcast(prefix_val, masked_identity, combine_max):
+            """Value of an exclusive prefix reduction AT MY RUN START,
+            gather-free: the prefix series is monotone within a segment,
+            so an inclusive segmented cummax/cummin over run-start-masked
+            values reproduces the latest run start's value."""
+            masked = jnp.where(run_start, prefix_val, masked_identity)
+            if combine_max:
+                return jnp.maximum(
+                    seg.seg_prefix_max(masked, starts, masked_identity),
+                    masked)
+            return jnp.minimum(
+                seg.seg_prefix_min(masked, starts, masked_identity), masked)
 
         # cases 1/3: lower above the greatest committed write/read ts seen
         # at access time (snapshots).  Independent of same-tick neighbors.
@@ -154,26 +192,25 @@ class Maat(CCPlugin):
 
         # exclude my own entries from the prefix pushes (a txn never pushes
         # itself; also keeps the fixed point free of self-oscillation on
-        # duplicate-key txns)
-        run_start_idx = seg.run_start_indices(starts, s_tx)
+        # duplicate-key txns): read the prefix value at my (key, txn)-run
+        # start
+        run_start = starts | seg.segment_starts(s_tx)
 
         def caps(okv, lov):
-            okx = okv[s_tx] & s_fin
-            lo_e = lov[s_tx]
-            pmw = seg.seg_prefix_min(
-                jnp.where(okx & s_iw, dn1(lo_e), BIG_TS), starts,
-                BIG_TS)[run_start_idx]
-            plr = seg.seg_prefix_max(
-                jnp.where(okx & ~s_iw, up1(lo_e), 0), starts,
-                0)[run_start_idx]
+            s_ok, s_lo = to_sorted(okv, lov)
+            okx = (s_ok == 1) & s_fin
+            pmw_full = seg.seg_prefix_min(
+                jnp.where(okx & s_iw, dn1(s_lo), BIG_TS), starts, BIG_TS)
+            pmw = run_start_bcast(pmw_full, BIG_TS, combine_max=False)
+            plr_full = seg.seg_prefix_max(
+                jnp.where(okx & ~s_iw, up1(s_lo), 0), starts, 0)
+            plr = run_start_bcast(plr_full, 0, combine_max=True)
             cap_e = jnp.where(s_fin, pmw, BIG_TS)
             push_e = jnp.where(s_fin & s_iw, plr, 0)
-            upper_new = jnp.minimum(
-                db["maat_upper"],
-                jnp.full(B, BIG_TS, jnp.int32).at[s_tx].min(cap_e))
-            lower_new = jnp.maximum(
-                static_lower,
-                jnp.zeros(B, jnp.int32).at[s_tx].max(push_e))
+            upper_new = jnp.minimum(db["maat_upper"],
+                                    txn_reduce(s_orig, cap_e, "min"))
+            lower_new = jnp.maximum(static_lower,
+                                    txn_reduce(s_orig, push_e, "max"))
             return lower_new, upper_new
 
         def step(carry):
@@ -212,20 +249,22 @@ class Maat(CCPlugin):
         # accesses never block: access r granted at start_tick + r//window.
         atick = (jnp.broadcast_to(txn.start_tick[:, None], (B, R))
                  + ridx // max(cfg.acquire_window, 1)).reshape(-1)
-        (k2, a2, t2), (w2, f2, x2) = seg.sort_by(
-            (key, atick, ts), (iw, fin_e, tx))
+        # running entries carry their CURRENT db bounds; committing entries
+        # their final validated bounds — shipped through the sort as
+        # payloads instead of gathered per lane afterwards
+        lo_cur = jnp.where(finishing, lower, db["maat_lower"])
+        up_cur = jnp.where(finishing, upper, db["maat_upper"])
+        bcast = lambda v: jnp.broadcast_to(
+            v[:, None].astype(jnp.int32), (B, R)).reshape(-1)
+        (k2, a2, t2), (w2, f2, ok2, lo2, up2, orig2) = seg.sort_by(
+            (key, atick, ts),
+            (iw, fin_e, bcast(ok), bcast(lo_cur), bcast(up_cur), orig))
         st2 = seg.segment_starts(k2)
         live2 = k2 != NULL_KEY
-        okx = ok[x2]
+        okx = ok2 == 1
         cw = live2 & f2 & w2 & okx          # committing writers
         cr = live2 & f2 & ~w2 & okx         # committing readers
         run2 = live2 & ~f2                  # live, not finishing
-        # running entries carry their CURRENT db bounds; committing entries
-        # their final validated bounds
-        lo_cur = jnp.where(finishing, lower, db["maat_lower"])
-        up_cur = jnp.where(finishing, upper, db["maat_upper"])
-        lo2 = lo_cur[x2]
-        up2 = up_cur[x2]
 
         # validator self-adjustment before the after-push (maat.cpp:145-156):
         # a committer's upper ducks under the range of a running writer it
@@ -235,11 +274,12 @@ class Maat(CCPlugin):
                                    jnp.where(lo2 > 1, lo2 - 1, BIG_TS)),
                          BIG_TS)
         pre_cand = seg.seg_prefix_min(cand, st2, BIG_TS)
-        adj = jnp.full(B, BIG_TS, jnp.int32).at[x2].min(
-            jnp.where(live2 & f2, pre_cand, BIG_TS))
+        adj = txn_reduce(orig2, jnp.where(live2 & f2, pre_cand, BIG_TS),
+                 "min")
         upper_v = jnp.where(ok, jnp.maximum(jnp.minimum(upper, adj),
                                             lower + 1), upper)
-        up2c = upper_v[x2]
+        _, _, _, up2c = jax.lax.sort((key, atick, ts, bcast(upper_v)),
+                                     num_keys=3, is_stable=False)
 
         # committers AFTER me in access order saw my entry (I was in their
         # uncommitted sets): their validation orders me AFTER them.
@@ -262,8 +302,10 @@ class Maat(CCPlugin):
         new_lo2 = jnp.where(run2 & w2, w_lo, 0)
         new_up2 = jnp.where(run2, jnp.where(w2, w_up, r_up), BIG_TS)
 
-        upper_arr = db["maat_upper"].at[x2].min(new_up2)
-        lower_arr = db["maat_lower"].at[x2].max(new_lo2)
+        upper_arr = jnp.minimum(db["maat_upper"],
+                                txn_reduce(orig2, new_up2, "min"))
+        lower_arr = jnp.maximum(db["maat_lower"],
+                                txn_reduce(orig2, new_lo2, "max"))
         # also persist the validators' own tightened bounds
         upper_arr = jnp.where(finishing, upper_v, upper_arr)
         lower_arr = jnp.where(finishing, lower, lower_arr)

@@ -54,9 +54,13 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from jax import lax
+
 from deneva_tpu.cc.base import AccessDecision, CCPlugin
 from deneva_tpu.config import Config
-from deneva_tpu.engine.state import BIG_TS, NULL_KEY, TxnState, make_entries
+from deneva_tpu.engine.state import (BIG_TS, NULL_KEY, TxnState,
+                                     contract_window, expand_window,
+                                     make_entries, request_window)
 from deneva_tpu.ops import segment as seg
 
 
@@ -65,10 +69,15 @@ class Mvcc(CCPlugin):
     new_ts_on_restart = True
 
     def init_db(self, cfg: Config, n_rows: int, B: int, R: int) -> dict:
+        # rings are stored FLAT (n_rows * H,), addressed as key*H + slot:
+        # a 2-D (n_rows, H) layout turns every .at[k, slot] update into an
+        # XLA transpose + dynamic-update-slice loop over the whole 512 MB
+        # array (~160 ms/tick at 16M rows); the flat layout keeps them
+        # plain 1-D scatters (PROFILE.md)
         H = cfg.his_recycle_len
         return {
-            "w_ring": jnp.zeros((n_rows, H), jnp.int32),
-            "r_ring": jnp.zeros((n_rows, H), jnp.int32),
+            "w_ring": jnp.zeros(n_rows * H, jnp.int32),
+            "r_ring": jnp.zeros(n_rows * H, jnp.int32),
             "rts0": jnp.zeros(n_rows, jnp.int32),
             "w_floor": jnp.zeros(n_rows, jnp.int32),
         }
@@ -88,9 +97,11 @@ class Mvcc(CCPlugin):
         evicted flags entries whose true target version may have left the
         ring (an evicted version-ts lies in (v_ts, ts]).
         """
-        n_rows, H = db["w_ring"].shape
+        n_rows = db["rts0"].shape[0]
+        H = db["w_ring"].shape[0] // n_rows
         k = jnp.clip(key, 0, n_rows - 1)
-        ring = db["w_ring"][k]                     # (n, H)
+        ring = db["w_ring"][(k * H)[:, None]
+                            + jnp.arange(H, dtype=jnp.int32)[None, :]]
         eligible = (ring > 0) & (ring <= ts[:, None])
         v_ts = jnp.max(jnp.where(eligible, ring, 0), axis=1)
         v_slot = jnp.argmax(jnp.where(eligible, ring, -1), axis=1)
@@ -101,15 +112,29 @@ class Mvcc(CCPlugin):
     def access(self, cfg: Config, db: dict, txn: TxnState, active):
         ent = make_entries(txn, active, window=cfg.acquire_window)
         n = ent.key.shape[0]
-        n_rows, H = db["w_ring"].shape
-        k = jnp.clip(ent.key, 0, n_rows - 1)
+        B, R = txn.keys.shape
+        n_rows = db["rts0"].shape[0]
+        H = db["w_ring"].shape[0] // n_rows
 
-        v_ts, v_slot, evicted = self._version_lookup(db, ent.key, ent.ts)
-        rts_v = jnp.where(v_ts > 0,
-                          db["r_ring"][k, v_slot], db["rts0"][k])
+        # version lookup at the REQUEST lanes only (B*W, not B*R: only
+        # requests consult per-row state; gathers are per-lane latency)
+        rkey, riw, valid = request_window(txn, active, cfg.acquire_window)
+        W = rkey.shape[1]
+        kw = rkey.reshape(-1)
+        tsw = jnp.broadcast_to(txn.ts[:, None], (B, W)).reshape(-1)
+        v_ts_w, v_slot_w, evicted_w = self._version_lookup(db, kw, tsw)
+        kwc = jnp.clip(kw, 0, n_rows - 1)
+        rts_v_w = jnp.where(v_ts_w > 0,
+                            db["r_ring"][kwc * H + v_slot_w],
+                            db["rts0"][kwc])
 
         # prewrite rule: a later read already observed my target version
-        w_abort = (rts_v > ent.ts) | evicted
+        w_abort_w = (rts_v_w > tsw) | evicted_w
+        w_abort = expand_window(
+            txn, w_abort_w.reshape(B, W)).reshape(-1)
+        evicted = expand_window(
+            txn, evicted_w.reshape(B, W)).reshape(-1)
+        v_ts = expand_window(txn, v_ts_w.reshape(B, W)).reshape(-1)
 
         # pending-prewrite prefix per row segment (ts order)
         (skey, sts), (s_iw, s_held, s_req, s_wab, s_orig) = seg.sort_by(
@@ -120,9 +145,10 @@ class Mvcc(CCPlugin):
         starts = seg.segment_starts(skey)
         live = skey != NULL_KEY
         pending_w = live & s_iw & (s_held | (s_req & ~s_wab))
-        # max pending-prewrite ts strictly before me in ts order
+        # max pending-prewrite ts strictly before me in ts order;
+        # un-permute by sorting on the original index (no scatter)
         pref = seg.seg_prefix_max(jnp.where(pending_w, sts, 0), starts)
-        pts = jnp.zeros_like(pref).at[s_orig].set(pref)
+        _, pts = lax.sort((s_orig, pref), num_keys=1, is_stable=False)
 
         r_wait = (pts > v_ts) & (pts > 0)
         r_abort = evicted
@@ -132,61 +158,88 @@ class Mvcc(CCPlugin):
         wait_e = ent.req & ~ent.is_write & ~r_abort & r_wait
         abort_e = ent.req & ~grant_e & ~wait_e
 
-        # granted reads record their rts on the version they read
-        gr = grant_e & ~ent.is_write
-        r_ring = db["r_ring"].at[k, v_slot].max(
-            jnp.where(gr & (v_ts > 0), ent.ts, 0))
-        rts0 = db["rts0"].at[ent.key].max(
-            jnp.where(gr & (v_ts == 0), ent.ts, 0), mode="drop")
+        # granted reads record their rts on the version they read;
+        # scatter from the request lanes (grant only exists there)
+        grant_w2 = grant_e.reshape(B, R)
+        gr_w = contract_window(txn, grant_w2, W).reshape(-1) \
+            & ~riw.reshape(-1)
+        r_ring = db["r_ring"].at[
+            jnp.where(gr_w & (v_ts_w > 0), kwc * H + v_slot_w,
+                      jnp.int32(2**31 - 1))].max(tsw, mode="drop")
+        rts0 = db["rts0"].at[
+            jnp.where(gr_w & (v_ts_w == 0), kw, NULL_KEY)].max(
+            tsw, mode="drop")
 
-        B, R = txn.keys.shape
-        return (AccessDecision(grant=grant_e.reshape(B, R),
+        return (AccessDecision(grant=grant_w2,
                                wait=wait_e.reshape(B, R),
                                abort=abort_e.reshape(B, R)),
                 {**db, "r_ring": r_ring, "rts0": rts0})
 
     def on_commit(self, cfg: Config, db: dict, txn: TxnState, committed,
                   commit_ts, tick):
-        # insert EVERY committed write as a version, newest-first per row,
-        # one rank per while_loop round (several same-tick commits to one
-        # row each install a version in the reference too — folding all but
-        # the newest into the floor was measured as a systematic +4% abort
-        # bias at zipf 0.9, PARITY.md); a version older than everything
-        # retained still folds into w_floor
+        # insert EVERY committed write as a version (several same-tick
+        # commits to one row each install a version in the reference too —
+        # folding all but the newest into the floor was measured as a
+        # systematic +4% abort bias at zipf 0.9, PARITY.md); a version
+        # older than everything retained still folds into w_floor
         B, R = txn.keys.shape
-        n_rows, H = db["w_ring"].shape
+        n_rows = db["rts0"].shape[0]
+        H = db["w_ring"].shape[0] // n_rows
         ridx = jnp.arange(R, dtype=jnp.int32)[None, :]
         wmask = (committed[:, None] & txn.is_write
                  & (ridx < txn.n_req[:, None])).reshape(-1)
         key = jnp.where(wmask, txn.keys.reshape(-1), NULL_KEY)
         ts = jnp.broadcast_to(txn.ts[:, None], (B, R)).reshape(-1)
 
-        # newest-first within each row: sort by (key, BIG - ts)
+        # newest-first within each row: sort by (key, BIG - ts); dead lanes
+        # sort last, so live committed writes are a PREFIX of the sorted
+        # array — slice it to K lanes and gather only those rings
         (skey, _), (sts, slive) = seg.sort_by(
             (key, BIG_TS - ts), (ts, wmask))
-        starts = seg.segment_starts(skey)
-        rank = seg.pos_in_segment(starts)
-        max_rank = jnp.max(jnp.where(slive, rank, 0))
+        K = min(skey.shape[0], 8192)
+        skeyK, stsK, sliveK = skey[:K], sts[:K], slive[:K]
+        kk = jnp.clip(skeyK, 0, n_rows - 1)
+        starts = seg.segment_starts(skeyK)
+        pos = seg.pos_in_segment(starts)     # rank among row's new versions
 
-        def body(carry):
-            r, w_ring, r_ring, w_floor = carry
-            sel = slive & (rank == r)
-            kk = jnp.where(sel, skey, n_rows)
-            ring = w_ring[jnp.clip(kk, 0, n_rows - 1)]       # (n, H)
-            slot = jnp.argmin(ring, axis=1).astype(jnp.int32)
-            evicted_ts = jnp.take_along_axis(ring, slot[:, None],
-                                             axis=1)[:, 0]
-            insert_ok = sel & (sts > evicted_ts)
-            ik = jnp.where(insert_ok, kk, n_rows)
-            w_ring = w_ring.at[ik, slot].set(sts, mode="drop")
-            r_ring = r_ring.at[ik, slot].set(0, mode="drop")
-            w_floor = w_floor.at[jnp.where(sel, kk, n_rows)].max(
-                jnp.where(insert_ok, evicted_ts, sts), mode="drop")
-            return r + 1, w_ring, r_ring, w_floor
+        # closed form of iterative newest-first min-slot insertion: the
+        # merged ring is the top-H of (old ring ∪ new versions).  A new
+        # version at in-row rank p survives iff p + |{old > v_p}| < H (once
+        # one folds, all younger fold too); a survivor replaces the p-th
+        # smallest old slot, whose value goes to the floor; folded versions
+        # fold their own ts into the floor.  No loop, ONE ring gather of K
+        # lanes (the old per-rank while_loop re-gathered B*R lanes per
+        # iteration — ~90 ms/tick at 16M rows).
+        ring = db["w_ring"][(kk * H)[:, None]
+                            + jnp.arange(H, dtype=jnp.int32)[None, :]]
+        cnt_gt = jnp.sum((ring > stsK[:, None]).astype(jnp.int32), axis=1)
+        survive = sliveK & (pos + cnt_gt < H)
+        ring_asc = jnp.sort(ring, axis=1)
+        slot_asc = jnp.argsort(ring, axis=1).astype(jnp.int32)
+        onehot = jnp.arange(H, dtype=jnp.int32)[None, :] \
+            == jnp.minimum(pos, H - 1)[:, None]
+        slot = jnp.sum(jnp.where(onehot, slot_asc, 0), axis=1)
+        old_at_p = jnp.sum(jnp.where(onehot, ring_asc, 0), axis=1)
 
-        _, w_ring, r_ring, w_floor = jax.lax.while_loop(
-            lambda c: c[0] <= max_rank, body,
-            (jnp.int32(0), db["w_ring"], db["r_ring"], db["w_floor"]))
+        iflat = jnp.where(survive, kk * H + slot, n_rows * H)
+        w_ring = db["w_ring"].at[iflat].set(stsK, mode="drop")
+        r_ring = db["r_ring"].at[iflat].set(0, mode="drop")
+        w_floor = db["w_floor"].at[jnp.where(sliveK, kk, n_rows)].max(
+            jnp.where(survive, old_at_p, stsK), mode="drop")
+
+        # >K committed write lanes in one tick (needs > 8192; admission is
+        # capped far below): fold the overflow into the floor (safe-abort
+        # direction), only when it actually happens
+        if skey.shape[0] > K:
+            tail_live = slive[K:]
+
+            def _fold(fl):
+                return fl.at[jnp.where(tail_live,
+                                       jnp.clip(skey[K:], 0, n_rows - 1),
+                                       n_rows)].max(sts[K:], mode="drop")
+
+            w_floor = jax.lax.cond(jnp.any(tail_live), _fold,
+                                   lambda fl: fl, w_floor)
         return {**db, "w_ring": w_ring, "r_ring": r_ring, "w_floor": w_floor}
 
 

@@ -81,19 +81,34 @@ class Occ(CCPlugin):
         starts = seg.segment_starts(skey)
         live = skey != NULL_KEY
         # a txn never conflicts with itself (test_valid intersects OTHER
-        # txns' sets); reading prefixes at the (key, txn)-run start also
-        # keeps the fixed point free of self-oscillation
-        run_start_idx = seg.run_start_indices(starts, s_tx)
+        # txns' sets): exclude my own run by reading the blocking count at
+        # my (key, txn)-run start
+        run_start = starts | seg.segment_starts(s_tx)
 
         def step(carry):
             valid, _ = carry
-            blocking = live & s_iw & valid[s_tx]
+            # ship per-txn validity into sorted entry order by re-sorting
+            # on the SAME fixed keys (a 3-operand sort is ~4x cheaper than
+            # the per-lane gathers valid[s_tx] / cnt[run_start_idx] it
+            # replaces, PROFILE.md)
+            valid_e = jnp.broadcast_to(valid[:, None], (B, R)).reshape(-1)
+            _, _, s_valid = jax.lax.sort(
+                (key, ts, valid_e.astype(jnp.int32)), num_keys=2,
+                is_stable=False)
+            blocking = live & s_iw & (s_valid == 1)
             cnt_before = seg.seg_cumsum_exclusive(
                 blocking.astype(jnp.int32), starts)
-            w_before = cnt_before[run_start_idx] > 0
-            conflict = jnp.zeros(n, dtype=bool).at[s_orig].set(
-                live & w_before)
-            new_valid = pass1 & ~conflict.reshape(B, R).any(axis=1)
+            # count at my run start, gather-free: cnt_before is
+            # non-decreasing within a segment, so the value at the last
+            # run start at-or-before me is a segmented inclusive cummax
+            # over run-start-masked counts
+            masked = jnp.where(run_start, cnt_before, -1)
+            at_start = jnp.maximum(
+                seg.seg_prefix_max(masked, starts, -1), masked)
+            conflict_s = (live & (at_start > 0)).astype(jnp.int32)
+            _, conflict = jax.lax.sort((s_orig, conflict_s), num_keys=1,
+                                       is_stable=False)
+            new_valid = pass1 & ~(conflict.reshape(B, R) == 1).any(axis=1)
             return new_valid, jnp.any(new_valid != valid)
 
         # initial changed=True derived from pass1 so its sharding (varying

@@ -38,11 +38,14 @@ txn; TPC-C programs access each row once per step).
 from __future__ import annotations
 
 import jax.numpy as jnp
+from jax import lax
 
 from deneva_tpu.cc.base import AccessDecision, CCPlugin
 from deneva_tpu.cc.twopl import ts_groups
 from deneva_tpu.config import Config
-from deneva_tpu.engine.state import TxnState, make_entries, NULL_KEY
+from deneva_tpu.engine.state import (NULL_KEY, TxnState, contract_window,
+                                     expand_window, make_entries,
+                                     request_window)
 from deneva_tpu.ops import segment as seg
 
 
@@ -60,7 +63,10 @@ def _decide(key, ts, is_write, held, req, w_abort, r_abort):
     live = skey != NULL_KEY
     pending_w = live & s_iw & (s_held | (s_req & ~s_wab))
     pw_before = seg.seg_any_before(pending_w, starts)
-    pw = jnp.zeros(n, dtype=bool).at[s_orig].set(pw_before)
+    # un-permute by sorting on the original index (cheaper than a scatter)
+    _, pw_i = lax.sort((s_orig, pw_before.astype(jnp.int32)), num_keys=1,
+                       is_stable=False)
+    pw = pw_i == 1
 
     grant = req & jnp.where(is_write, ~w_abort, ~r_abort & ~pw)
     wait = req & ~is_write & ~r_abort & pw
@@ -87,26 +93,37 @@ class Timestamp(CCPlugin):
         if cfg.sub_ticks > 1:
             return self._access_subticked(cfg, db, txn, active)
         ent = make_entries(txn, active, window=cfg.acquire_window)
-        wts_k = db["wts"][jnp.clip(ent.key, 0, db["wts"].shape[0] - 1)]
-        rts_k = db["rts"][jnp.clip(ent.key, 0, db["rts"].shape[0] - 1)]
+        B, R = txn.keys.shape
+        n_rows = db["wts"].shape[0]
 
-        # per-request dense-state rules (independent of other entries)
+        # gather row state at the REQUEST lanes only (B*W, not B*R: the
+        # decision consults wts/rts only where req is set)
+        rkey, riw, valid = request_window(txn, active, cfg.acquire_window)
+        kr = jnp.clip(rkey, 0, n_rows - 1).reshape(-1)
+        wts_r = db["wts"][kr].reshape(rkey.shape)
+        rts_r = db["rts"][kr].reshape(rkey.shape)
+        tsw = txn.ts[:, None]
         if cfg.ts_twr:
-            w_abort = ent.ts < rts_k
+            w_abort_w = tsw < rts_r
         else:
-            w_abort = (ent.ts < rts_k) | (ent.ts < wts_k)
-        r_abort = ent.ts < wts_k
+            w_abort_w = (tsw < rts_r) | (tsw < wts_r)
+        r_abort_w = tsw < wts_r
+        w_abort = expand_window(txn, w_abort_w).reshape(-1)
+        r_abort = expand_window(txn, r_abort_w).reshape(-1)
 
         grant_e, wait_e, abort_e = _decide(
             ent.key, ent.ts, ent.is_write, ent.held, ent.req,
             w_abort, r_abort)
 
-        # granted reads advance rts immediately (row_ts.cpp:187-189)
-        rts = db["rts"].at[ent.key].max(
-            jnp.where(grant_e & ~ent.is_write, ent.ts, 0), mode="drop")
+        # granted reads advance rts immediately (row_ts.cpp:187-189);
+        # scatter from the request lanes (grant is only ever set there)
+        grant_w = grant_e.reshape(B, R)
+        gr_w = contract_window(txn, grant_w, rkey.shape[1])
+        rts = db["rts"].at[jnp.where(gr_w & ~riw, rkey,
+                                     NULL_KEY).reshape(-1)].max(
+            jnp.broadcast_to(tsw, rkey.shape).reshape(-1), mode="drop")
 
-        B, R = txn.keys.shape
-        return (AccessDecision(grant=grant_e.reshape(B, R),
+        return (AccessDecision(grant=grant_w,
                                wait=wait_e.reshape(B, R),
                                abort=abort_e.reshape(B, R)),
                 {**db, "rts": rts})

@@ -87,6 +87,59 @@ class Entries(NamedTuple):
     req: jnp.ndarray       # (B*R,) bool
 
 
+def request_window(txn: TxnState, active: jnp.ndarray, window: int = 1):
+    """Extract the requested accesses [cursor, cursor+window) as dense
+    (B, W) arrays — the lanes a CC kernel must consult per-row state for.
+
+    Gathering row state (wts/rts, version rings, access sets) at these
+    B*W lanes instead of all B*R entry lanes is the difference between a
+    ~0.2 ms and a ~2 ms tick stage on TPU (PROFILE.md): dynamic-index
+    gathers are latency-bound per lane.
+
+    Returns (rkey, riw, valid): key, is_write and validity, NULL_KEY keyed
+    where invalid.  Use ``expand_window`` to place per-lane results back
+    into (B, R) entry order.
+    """
+    B, R = txn.keys.shape
+    ridx = jnp.arange(R, dtype=jnp.int32)[None, :]
+    cur = txn.cursor[:, None]
+    rkey, riw, valid = [], [], []
+    for j in range(min(window, R)):
+        m = ridx == cur + j
+        v = active & (txn.cursor + j < txn.n_req)
+        rkey.append(jnp.where(v, jnp.sum(jnp.where(m, txn.keys, 0), axis=1),
+                              NULL_KEY))
+        riw.append(jnp.any(m & txn.is_write, axis=1) & v)
+        valid.append(v)
+    return (jnp.stack(rkey, axis=1), jnp.stack(riw, axis=1),
+            jnp.stack(valid, axis=1))
+
+
+def expand_window(txn: TxnState, vals, fill=0):
+    """Scatter-free inverse of ``request_window``: place (B, W) per-request
+    values into (B, R) entry order (value at lane cursor+j, `fill`
+    elsewhere) with elementwise selects."""
+    B, R = txn.keys.shape
+    W = vals.shape[1]
+    ridx = jnp.arange(R, dtype=jnp.int32)[None, :]
+    cur = txn.cursor[:, None]
+    out = jnp.full((B, R), fill, dtype=vals.dtype)
+    for j in range(W):
+        out = jnp.where(ridx == cur + j, vals[:, j:j + 1], out)
+    return out
+
+
+def contract_window(txn: TxnState, mask, W: int):
+    """Inverse of ``expand_window`` for boolean masks: collapse a (B, R)
+    entry-order mask to (B, W) request-window order (lane j holds the value
+    at access cursor+j)."""
+    B, R = txn.keys.shape
+    ridx = jnp.arange(R, dtype=jnp.int32)[None, :]
+    cur = txn.cursor[:, None]
+    return jnp.stack([jnp.any(mask & (ridx == cur + j), axis=1)
+                      for j in range(W)], axis=1)
+
+
 def make_entries(txn: TxnState, active: jnp.ndarray,
                  read_locks_held: bool = True,
                  window: int = 1) -> Entries:

@@ -149,22 +149,6 @@ def seg_prefix_min(vals: jnp.ndarray, starts: jnp.ndarray,
     return _seg_scan(vals, starts, jnp.minimum, identity)
 
 
-def run_start_indices(starts: jnp.ndarray, owner: jnp.ndarray) -> jnp.ndarray:
-    """Index of the first element of my (segment, owner)-run.
-
-    Requires same-owner elements to be CONTIGUOUS within each segment (true
-    after a stable (key, ts) sort when ts is unique per owner).  Reading an
-    exclusive-prefix value at this index skips exactly the caller's own
-    entries — the "a txn never conflicts with itself" exclusion used by the
-    OCC and MaaT validators.
-    """
-    n = starts.shape[0]
-    idx = jnp.arange(n, dtype=jnp.int32)
-    run_starts = starts | jnp.where(idx == 0, True,
-                                    owner != jnp.roll(owner, 1))
-    return lax.cummax(jnp.where(run_starts, idx, 0))
-
-
 def _seg_ends(starts: jnp.ndarray) -> jnp.ndarray:
     """Mask marking the last element of each equal-id run."""
     return jnp.roll(starts, -1).at[-1].set(True)
