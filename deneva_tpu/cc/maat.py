@@ -145,26 +145,10 @@ class Maat(CCPlugin):
             return out[2:]
 
         def txn_reduce(perm, sorted_val, op):
-            """Per-txn reduction over sorted entries: sort back to entry
-            order on the given original-index permutation, reduce over the
-            R lanes."""
-            _, v = jax.lax.sort((perm, sorted_val), num_keys=1,
-                                is_stable=False)
-            v = v.reshape(B, R)
+            """Per-txn reduction over sorted entries: un-permute to entry
+            order, reduce over the R lanes."""
+            v = seg.unpermute(perm, sorted_val).reshape(B, R)
             return v.min(axis=1) if op == "min" else v.max(axis=1)
-
-        def run_start_bcast(prefix_val, masked_identity, combine_max):
-            """Value of an exclusive prefix reduction AT MY RUN START,
-            gather-free: the prefix series is monotone within a segment,
-            so an inclusive segmented cummax/cummin over run-start-masked
-            values reproduces the latest run start's value."""
-            masked = jnp.where(run_start, prefix_val, masked_identity)
-            if combine_max:
-                return jnp.maximum(
-                    seg.seg_prefix_max(masked, starts, masked_identity),
-                    masked)
-            return jnp.minimum(
-                seg.seg_prefix_min(masked, starts, masked_identity), masked)
 
         # cases 1/3: lower above the greatest committed write/read ts seen
         # at access time (snapshots).  Independent of same-tick neighbors.
@@ -201,10 +185,11 @@ class Maat(CCPlugin):
             okx = (s_ok == 1) & s_fin
             pmw_full = seg.seg_prefix_min(
                 jnp.where(okx & s_iw, dn1(s_lo), BIG_TS), starts, BIG_TS)
-            pmw = run_start_bcast(pmw_full, BIG_TS, combine_max=False)
+            pmw = seg.at_run_start(pmw_full, run_start, starts, BIG_TS,
+                                   "min")
             plr_full = seg.seg_prefix_max(
                 jnp.where(okx & ~s_iw, up1(s_lo), 0), starts, 0)
-            plr = run_start_bcast(plr_full, 0, combine_max=True)
+            plr = seg.at_run_start(plr_full, run_start, starts, 0, "max")
             cap_e = jnp.where(s_fin, pmw, BIG_TS)
             push_e = jnp.where(s_fin & s_iw, plr, 0)
             upper_new = jnp.minimum(db["maat_upper"],

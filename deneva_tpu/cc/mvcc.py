@@ -54,7 +54,6 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from jax import lax
 
 from deneva_tpu.cc.base import AccessDecision, CCPlugin
 from deneva_tpu.config import Config
@@ -145,10 +144,9 @@ class Mvcc(CCPlugin):
         starts = seg.segment_starts(skey)
         live = skey != NULL_KEY
         pending_w = live & s_iw & (s_held | (s_req & ~s_wab))
-        # max pending-prewrite ts strictly before me in ts order;
-        # un-permute by sorting on the original index (no scatter)
+        # max pending-prewrite ts strictly before me in ts order
         pref = seg.seg_prefix_max(jnp.where(pending_w, sts, 0), starts)
-        _, pts = lax.sort((s_orig, pref), num_keys=1, is_stable=False)
+        pts = seg.unpermute(s_orig, pref)
 
         r_wait = (pts > v_ts) & (pts > 0)
         r_abort = evicted
@@ -196,7 +194,12 @@ class Mvcc(CCPlugin):
         # array — slice it to K lanes and gather only those rings
         (skey, _), (sts, slive) = seg.sort_by(
             (key, BIG_TS - ts), (ts, wmask))
-        K = min(skey.shape[0], 8192)
+        # slice width: 2x the steady-state write-lane bound (admission cap
+        # x writes per txn) so only a multi-tick commit burst can straddle
+        # it — and a straddle folds into the floor (safe-abort direction),
+        # it cannot lose a committed write's visibility
+        acap = cfg.admit_cap if cfg.admit_cap is not None else B
+        K = min(skey.shape[0], max(8192, 2 * acap * R))
         skeyK, stsK, sliveK = skey[:K], sts[:K], slive[:K]
         kk = jnp.clip(skeyK, 0, n_rows - 1)
         starts = seg.segment_starts(skeyK)

@@ -98,17 +98,10 @@ class Occ(CCPlugin):
             blocking = live & s_iw & (s_valid == 1)
             cnt_before = seg.seg_cumsum_exclusive(
                 blocking.astype(jnp.int32), starts)
-            # count at my run start, gather-free: cnt_before is
-            # non-decreasing within a segment, so the value at the last
-            # run start at-or-before me is a segmented inclusive cummax
-            # over run-start-masked counts
-            masked = jnp.where(run_start, cnt_before, -1)
-            at_start = jnp.maximum(
-                seg.seg_prefix_max(masked, starts, -1), masked)
-            conflict_s = (live & (at_start > 0)).astype(jnp.int32)
-            _, conflict = jax.lax.sort((s_orig, conflict_s), num_keys=1,
-                                       is_stable=False)
-            new_valid = pass1 & ~(conflict.reshape(B, R) == 1).any(axis=1)
+            at_start = seg.at_run_start(cnt_before, run_start, starts,
+                                        -1, "max")
+            conflict = seg.unpermute(s_orig, live & (at_start > 0))
+            new_valid = pass1 & ~conflict.reshape(B, R).any(axis=1)
             return new_valid, jnp.any(new_valid != valid)
 
         # initial changed=True derived from pass1 so its sharding (varying

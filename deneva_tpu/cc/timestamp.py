@@ -38,7 +38,6 @@ txn; TPC-C programs access each row once per step).
 from __future__ import annotations
 
 import jax.numpy as jnp
-from jax import lax
 
 from deneva_tpu.cc.base import AccessDecision, CCPlugin
 from deneva_tpu.cc.twopl import ts_groups
@@ -63,10 +62,7 @@ def _decide(key, ts, is_write, held, req, w_abort, r_abort):
     live = skey != NULL_KEY
     pending_w = live & s_iw & (s_held | (s_req & ~s_wab))
     pw_before = seg.seg_any_before(pending_w, starts)
-    # un-permute by sorting on the original index (cheaper than a scatter)
-    _, pw_i = lax.sort((s_orig, pw_before.astype(jnp.int32)), num_keys=1,
-                       is_stable=False)
-    pw = pw_i == 1
+    pw = seg.unpermute(s_orig, pw_before)
 
     grant = req & jnp.where(is_write, ~w_abort, ~r_abort & ~pw)
     wait = req & ~is_write & ~r_abort & pw

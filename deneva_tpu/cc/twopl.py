@@ -106,10 +106,7 @@ def arbitrate(ent: Entries, policy: str):
 
     packed = (s_grant.astype(jnp.int32) | (s_wait.astype(jnp.int32) << 1)
               | (s_abort.astype(jnp.int32) << 2))
-    # un-permute by SORTING on the original index (s_idx is a permutation
-    # of iota): a 2-operand bitonic sort costs ~0.1 ms at 160k lanes where
-    # the equivalent 160k-lane scatter costs ~0.4 ms (PROFILE.md)
-    _, out = lax.sort((s_idx, packed), num_keys=1, is_stable=False)
+    out = seg.unpermute(s_idx, packed)
     return out & 1 == 1, (out >> 1) & 1 == 1, (out >> 2) & 1 == 1
 
 
@@ -271,7 +268,7 @@ def arbitrate_window(txn, active, policy: str, tmp: dict,
 
     packed = (s_grant.astype(jnp.int32) | (s_wait.astype(jnp.int32) << 1)
               | (s_abort.astype(jnp.int32) << 2))
-    _, out = lax.sort((s_idx, packed), num_keys=1, is_stable=False)
+    out = seg.unpermute(s_idx, packed)
     grantW = (out & 1 == 1).reshape(B, W)
     waitW = ((out >> 1) & 1 == 1).reshape(B, W)
     abortW = ((out >> 2) & 1 == 1).reshape(B, W)

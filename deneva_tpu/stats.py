@@ -20,6 +20,7 @@ unit) using the measured mean tick duration; otherwise times are in ticks.
 from __future__ import annotations
 
 import re
+import time
 
 import numpy as np
 
@@ -88,7 +89,31 @@ def reference_summary(s: dict, wall_seconds: float | None = None) -> dict:
     if "ccl_samples" in s:
         ccl = latency_percentiles(s["ccl_samples"], s.get("ccl_valid", 0))
         out.update({k: v * tick_sec for k, v in ccl.items()})
+    out.update(host_utilization())
     return out
+
+
+#: wall-clock origin for cpu_util (os.times().elapsed counts from an
+#: arbitrary epoch — boot on Linux — not process start)
+_T0 = time.monotonic()
+
+
+def host_utilization() -> dict:
+    """mem_util / cpu_util of this process, matching the reference's
+    /proc-sourced dump keys (stats.cpp:1556-1562: VmRSS in MB and process
+    CPU seconds / wall seconds since start)."""
+    mem_mb = 0.0
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    mem_mb = float(line.split()[1]) / 1024.0
+                    break
+    except OSError:  # pragma: no cover - non-procfs platform
+        pass
+    wall = time.monotonic() - _T0
+    cpu = time.process_time() / wall if wall > 0 else 0.0
+    return {"mem_util": mem_mb, "cpu_util": cpu}
 
 
 def format_summary(d: dict, prog: bool = False) -> str:
