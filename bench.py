@@ -32,7 +32,7 @@ NORTH_STAR_CHIPS = 8
 
 
 def run_cell(acquire_window: int, batch_size: int, admit_cap: int,
-             n_ticks: int = 300) -> float:
+             n_ticks: int = 300, with_summary: bool = False):
     cfg = Config(
         cc_alg="NO_WAIT",
         batch_size=batch_size,
@@ -66,7 +66,10 @@ def run_cell(acquire_window: int, batch_size: int, admit_cap: int,
         dt = time.perf_counter() - t0
         committed = int(np.asarray(state.stats["txn_cnt"])) - committed_before
         tputs.append(committed / dt)
-    return float(np.median(tputs))
+    tput = float(np.median(tputs))
+    if with_summary:
+        return tput, eng.summary(state)
+    return tput
 
 
 def main():

@@ -61,6 +61,10 @@ class CCPlugin:
     #: Calvin: no abort path exists (row_lock.cpp:78-81); the sharded
     #: engine defers instead of aborting on routing overflow.
     never_aborts: bool = False
+    #: strict-2PL family: granted write accesses are exclusive row locks,
+    #: so the debug invariant kernel may assert the lock matrix
+    #: (engine/debug.py, row_lock.cpp:309-314).
+    lock_based: bool = False
 
     # --- multi-shard support (deneva_tpu/parallel/sharded.py) ---
     #: db keys holding per-TXN-slot (B,) arrays that must travel with each

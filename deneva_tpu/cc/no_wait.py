@@ -26,6 +26,7 @@ from deneva_tpu.engine.state import TxnState, make_entries, NULL_KEY
 
 class TwoPLPlugin(CCPlugin):
     policy = "NO_WAIT"
+    lock_based = True
 
     def _window_path(self, cfg: Config) -> bool:
         """The sort-free window arbitration covers the common isolation

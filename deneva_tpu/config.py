@@ -66,6 +66,10 @@ class Config:
     cc_alg: str = NO_WAIT
     isolation_level: str = SERIALIZABLE
     mode: str = MODE_NORMAL      # debug ladder (config.h:314-319)
+    #: DEBUG_ASSERT/DEBUG_RACE analog (config.h:265-268): run the
+    #: invariant-check kernel every tick, counting violations into the
+    #: ``invariant_violation_cnt`` stat (engine/debug.py)
+    debug_invariants: bool = False
 
     # --- scheduler / batch engine (replaces MAX_TXN_IN_FLIGHT + worker loop) ---
     batch_size: int = 4096       # concurrent in-flight txns per node (B)

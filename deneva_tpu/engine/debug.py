@@ -1,0 +1,77 @@
+"""Device-side invariant checking — the DEBUG_ASSERT / DEBUG_RACE analog.
+
+The reference guards its shared structures with compile-time assertion
+blocks (config.h:265-268; e.g. the owner-count check in
+row_lock.cpp:309-314).  Batched execution makes data races structural —
+there are no latches to misuse — so the equivalent safety net is a pure
+kernel over the scheduler state that counts INVARIANT VIOLATIONS into a
+stats counter each tick (SURVEY.md §5 "race detection"):
+
+  1. slot status in its enum domain;
+  2. live slots keep 0 <= cursor <= n_req <= R;
+  3. a WAITING slot has an outstanding access (cursor < n_req);
+  4. live slots carry a positive timestamp;
+  5. timestamps are unique among live slots (the ts oracle's contract —
+     every arbitration tie-break depends on it);
+  6. for lock-based algorithms (strict 2PL under SERIALIZABLE), the lock
+     matrix is consistent: a row with an exclusive (write) holder has
+     exactly ONE holder (row_lock.cpp:309-314).
+
+Enabled by ``Config.debug_invariants``; the counter must stay 0 on every
+healthy run (enforced by tests/test_modes.py) and is reported in
+``[summary]`` as ``invariant_violation_cnt``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from deneva_tpu.config import SERIALIZABLE, Config
+from deneva_tpu.engine.state import (NULL_KEY, STATUS_BACKOFF, STATUS_FREE,
+                                     STATUS_RUNNING, STATUS_WAITING, TxnState)
+from deneva_tpu.ops import segment as seg
+
+
+def count_violations(cfg: Config, plugin, txn: TxnState) -> jnp.ndarray:
+    """int32 scalar: number of invariant violations in this tick's state."""
+    B, R = txn.keys.shape
+    live = (txn.status == STATUS_RUNNING) | (txn.status == STATUS_WAITING)
+
+    bad_status = ~((txn.status >= STATUS_FREE)
+                   & (txn.status <= STATUS_BACKOFF))
+    bad_cursor = live & ((txn.cursor < 0) | (txn.cursor > txn.n_req)
+                         | (txn.n_req > R))
+    bad_wait = (txn.status == STATUS_WAITING) & (txn.cursor >= txn.n_req)
+    bad_ts = live & (txn.ts <= 0)
+
+    # ts uniqueness among live slots: sort and compare neighbours
+    tss = lax.sort(jnp.where(live, txn.ts, jnp.int32(2**31 - 1)))
+    dup = (tss[1:] == tss[:-1]) & (tss[1:] != jnp.int32(2**31 - 1))
+
+    n_bad = (jnp.sum(bad_status.astype(jnp.int32))
+             + jnp.sum(bad_cursor.astype(jnp.int32))
+             + jnp.sum(bad_wait.astype(jnp.int32))
+             + jnp.sum(bad_ts.astype(jnp.int32))
+             + jnp.sum(dup.astype(jnp.int32)))
+
+    if getattr(plugin, "lock_based", False) \
+            and cfg.isolation_level == SERIALIZABLE:
+        # lock-matrix consistency: an exclusively held row has one holder
+        ridx = jnp.arange(R, dtype=jnp.int32)[None, :]
+        held = live[:, None] & (ridx < txn.cursor[:, None]) \
+            & (ridx < txn.n_req[:, None])
+        key = jnp.where(held, txn.keys, NULL_KEY).reshape(-1)
+        skey, s_iw = lax.sort(
+            (key, txn.is_write.reshape(-1).astype(jnp.int32)), num_keys=1,
+            is_stable=False)
+        starts = seg.segment_starts(skey)
+        slive = skey != NULL_KEY
+        n_held = seg.seg_reduce(slive.astype(jnp.int32), starts, "sum")
+        any_x = seg.seg_reduce(jnp.where(slive, s_iw, 0), starts,
+                               "max") == 1
+        # count each violating ROW once (at its segment start)
+        bad_row = starts & slive & any_x & (n_held > 1)
+        n_bad = n_bad + jnp.sum(bad_row.astype(jnp.int32))
+
+    return n_bad.astype(jnp.int32)

@@ -66,6 +66,7 @@ STAT_KEYS_I32 = (
     "parts_touched",           # sum over commits of distinct partitions
     "multi_part_txn_cnt",      # commits touching > 1 partition
     "measured_ticks",          # post-warmup ticks elapsed
+    "invariant_violation_cnt",  # debug kernel hits (engine/debug.py)
 )
 STAT_KEYS_F32 = (
     "txn_run_time_ticks",      # sum of short latency (last restart -> commit)
@@ -610,6 +611,13 @@ def make_tick(cfg: Config, plugin, pool_dev: dict, workload=None):
             stats = {**stats, "arr_wr_ring": ring,
                      "wr_ring_cursor": jnp.where(
                          need, 0, stats["wr_ring_cursor"])}
+
+        if cfg.debug_invariants:
+            from deneva_tpu.engine import debug as dbg
+            stats = {**stats,
+                     "invariant_violation_cnt":
+                     stats["invariant_violation_cnt"]
+                     + dbg.count_violations(cfg, plugin, txn)}
 
         stats = bump(stats, "measured_ticks", 1, measuring)
         return EngineState(txn=txn, db=db, data=data, tables=tables,

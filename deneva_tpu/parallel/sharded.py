@@ -490,6 +490,17 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
         txn, db, ts_counter = jax.lax.cond(
             global_max > limit, _rebase, lambda op: op, (txn, db, ts_counter))
 
+        if cfg.debug_invariants:
+            # per-shard invariant kernel over the HOME txn slots: intra-node
+            # checks only (two of one node's txns holding X on one global
+            # row is a true violation; cross-node lock conflicts are not
+            # visible locally and go undetected here)
+            from deneva_tpu.engine import debug as dbg
+            stats = {**stats,
+                     "invariant_violation_cnt":
+                     stats["invariant_violation_cnt"]
+                     + dbg.count_violations(cfg, plugin, txn)}
+
         stats = bump(stats, "measured_ticks", 1, measuring)
         return ShardState(txn=txn, db=db, data=data, tables=tables,
                           stats=stats, tick=t + 1,

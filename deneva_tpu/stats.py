@@ -93,9 +93,11 @@ def reference_summary(s: dict, wall_seconds: float | None = None) -> dict:
     return out
 
 
-#: wall-clock origin for cpu_util (os.times().elapsed counts from an
-#: arbitrary epoch — boot on Linux — not process start)
+#: matched epoch origins for cpu_util (os.times().elapsed counts from an
+#: arbitrary epoch — boot on Linux; process_time counts from process
+#: start — both must be measured over the SAME window)
 _T0 = time.monotonic()
+_P0 = time.process_time()
 
 
 def host_utilization() -> dict:
@@ -112,7 +114,7 @@ def host_utilization() -> dict:
     except OSError:  # pragma: no cover - non-procfs platform
         pass
     wall = time.monotonic() - _T0
-    cpu = time.process_time() / wall if wall > 0 else 0.0
+    cpu = (time.process_time() - _P0) / wall if wall > 0 else 0.0
     return {"mem_util": mem_mb, "cpu_util": cpu}
 
 

@@ -4,45 +4,25 @@ The tuned operating point shifts whenever the tick gets faster (the
 abort-rate equilibrium depends on in-flight concurrency, not kernel cost),
 so re-run this after kernel work and pin the winners in bench.py.
 
+Measurement goes through bench.run_cell — the SAME warmup/median protocol
+as the benchmark that pins the winners.
+
 Usage: python experiments/sweep_operating_point.py [faithful|greedy|both]
 """
 
 from __future__ import annotations
 
-import sys
 import os
-import time
+import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-import jax
-import numpy as np
-
-from deneva_tpu.config import Config
-from deneva_tpu.engine.scheduler import Engine
-
-ITERS = 200
+from bench import run_cell  # noqa: E402
 
 
 def cell(window, B, cap):
-    cfg = Config(cc_alg="NO_WAIT", batch_size=B, synth_table_size=1 << 24,
-                 req_per_query=10, zipf_theta=0.6, tup_read_perc=0.5,
-                 query_pool_size=1 << 16, warmup_ticks=0, backoff=True,
-                 acquire_window=window, admit_cap=cap)
-    eng = Engine(cfg)
-    st = eng.run_compiled(ITERS)
-    st = eng.run_compiled(ITERS, st)
-    jax.block_until_ready(st.stats["txn_cnt"])
-    tputs = []
-    for _ in range(3):
-        before = int(np.asarray(st.stats["txn_cnt"]))
-        t0 = time.perf_counter()
-        st = eng.run_compiled(ITERS, st)
-        jax.block_until_ready(st.stats["txn_cnt"])
-        dt = time.perf_counter() - t0
-        tputs.append((int(np.asarray(st.stats["txn_cnt"])) - before) / dt)
-    s = eng.summary(st)
-    tput = float(np.median(tputs))
+    tput, s = run_cell(acquire_window=window, batch_size=B, admit_cap=cap,
+                       n_ticks=200, with_summary=True)
     print(f"win={window} B={B:>6} cap={cap!s:>5}: {tput/1e3:8.1f} k/s  "
           f"abort={s['abort_rate']:.3f}", flush=True)
     return tput

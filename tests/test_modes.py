@@ -60,3 +60,75 @@ def test_nocc_matches_nolock_isolation():
     b, _ = run("NORMAL", isolation_level="NOLOCK")
     assert a["txn_cnt"] == b["txn_cnt"]
     assert a["write_cnt"] == b["write_cnt"]
+
+
+# ---------------------------------------------------------------------------
+# invariant-check kernel (DEBUG_ASSERT/DEBUG_RACE analog, engine/debug.py)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("alg", CC_ALGS)
+def test_invariant_kernel_clean_on_healthy_runs(alg):
+    s, _ = run("NORMAL", alg=alg, debug_invariants=True)
+    assert s["invariant_violation_cnt"] == 0
+    assert s["txn_cnt"] > 0
+
+
+def test_invariant_kernel_detects_corruption():
+    from deneva_tpu.engine import debug as dbg
+    from deneva_tpu import cc as cc_registry
+    cfg = Config(cc_alg="NO_WAIT", batch_size=64, synth_table_size=1 << 10,
+                 req_per_query=4, query_pool_size=1 << 8,
+                 debug_invariants=True)
+    eng = Engine(cfg)
+    st = eng.run(10)
+    plugin = cc_registry.get("NO_WAIT")
+    assert int(dbg.count_violations(cfg, plugin, st.txn)) == 0
+
+    # duplicate timestamp between two live slots
+    ts = np.asarray(st.txn.ts).copy()
+    status = np.asarray(st.txn.status).copy()
+    status[0] = status[1] = 1          # RUNNING
+    ts[0] = ts[1] = 7777
+    bad = st.txn._replace(ts=np.asarray(ts), status=np.asarray(status))
+    assert int(dbg.count_violations(cfg, plugin, bad)) > 0
+
+    # cursor past n_req on a live slot
+    cur = np.asarray(st.txn.cursor).copy()
+    cur[2] = int(np.asarray(st.txn.n_req)[2]) + 1
+    status2 = np.asarray(st.txn.status).copy()
+    status2[2] = 1
+    bad2 = st.txn._replace(cursor=np.asarray(cur),
+                           status=np.asarray(status2))
+    assert int(dbg.count_violations(cfg, plugin, bad2)) > 0
+
+    # two exclusive holders on one row (lock-matrix check)
+    keys = np.asarray(st.txn.keys).copy()
+    iw = np.asarray(st.txn.is_write).copy()
+    cur3 = np.asarray(st.txn.cursor).copy()
+    status3 = np.asarray(st.txn.status).copy()
+    ts3 = np.asarray(st.txn.ts).copy()
+    nrq = np.asarray(st.txn.n_req).copy()
+    for slot, t in ((4, 1001), (5, 1002)):
+        status3[slot] = 1
+        keys[slot, 0] = 99
+        iw[slot, 0] = True
+        cur3[slot] = 1
+        nrq[slot] = 4
+        ts3[slot] = t
+    bad3 = st.txn._replace(keys=np.asarray(keys), is_write=np.asarray(iw),
+                           cursor=np.asarray(cur3),
+                           status=np.asarray(status3),
+                           ts=np.asarray(ts3), n_req=np.asarray(nrq))
+    assert int(dbg.count_violations(cfg, plugin, bad3)) > 0
+
+
+def test_invariant_kernel_clean_sharded():
+    from deneva_tpu.parallel.sharded import ShardedEngine
+    cfg = Config(cc_alg="NO_WAIT", node_cnt=2, part_cnt=2, batch_size=64,
+                 synth_table_size=1 << 10, req_per_query=4,
+                 query_pool_size=1 << 8, debug_invariants=True)
+    eng = ShardedEngine(cfg)
+    st = eng.run(20, eng.init_state())
+    s = eng.summary(st)
+    assert s["invariant_violation_cnt"] == 0
+    assert s["txn_cnt"] > 0
