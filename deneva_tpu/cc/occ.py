@@ -56,11 +56,55 @@ class Occ(CCPlugin):
         wmask = valid_acc & txn.is_write
 
         # --- history check: a committed write landed on my read set after
-        # my (re)start (occ.cpp:167-180) ---
-        k = jnp.clip(txn.keys, 0, n_rows - 1)
-        hist_conflict = rmask & (db["occ_wcommit"][k] > txn.start_tick[:, None])
-        pass1 = finishing & ~hist_conflict.any(axis=1)
+        # my (re)start (occ.cpp:167-180).  Only FINISHING txns consult the
+        # table, so compact their rows into a K-row buffer first (row
+        # scatters are cheap; the K*R-lane gather replaces a B*R-lane one,
+        # PROFILE.md); a >K finishing burst falls back to the full-width
+        # gather under lax.cond ---
+        K = min(B, 2048)
+        if K >= B:
+            # compaction saves nothing at small batches — gather full-width
+            k = jnp.clip(txn.keys, 0, n_rows - 1)
+            conf = rmask & (db["occ_wcommit"][k] > txn.start_tick[:, None])
+            pass1 = finishing & ~conf.any(axis=1)
+            return self._active_writer_fixed_point(cfg, db, txn, finishing,
+                                                   pass1)
+        n_fin = jnp.sum(finishing.astype(jnp.int32))
+        frank = jnp.cumsum(finishing.astype(jnp.int32)) \
+            - finishing.astype(jnp.int32)
+        rowpos = jnp.where(finishing, frank,
+                           K + jnp.arange(B, dtype=jnp.int32))
+        buf_keys = jnp.full((K, R), NULL_KEY, jnp.int32).at[rowpos].set(
+            jnp.where(rmask, txn.keys, NULL_KEY), mode="drop",
+            unique_indices=True)
+        buf_start = jnp.zeros(K, jnp.int32).at[rowpos].set(
+            txn.start_tick, mode="drop", unique_indices=True)
+        # inverse map: rank -> slot, for scattering the verdict back
+        slot_of_rank = jnp.full(K, B, jnp.int32).at[rowpos].set(
+            jnp.arange(B, dtype=jnp.int32), mode="drop",
+            unique_indices=True)
 
+        def _hist_compact(_):
+            kb = jnp.clip(buf_keys, 0, n_rows - 1)
+            conf = (buf_keys != NULL_KEY) \
+                & (db["occ_wcommit"][kb] > buf_start[:, None])
+            bad_buf = conf.any(axis=1)
+            return jnp.zeros(B, dtype=bool).at[slot_of_rank].set(
+                bad_buf, mode="drop", unique_indices=True)
+
+        def _hist_full(_):
+            k = jnp.clip(txn.keys, 0, n_rows - 1)
+            conf = rmask & (db["occ_wcommit"][k] > txn.start_tick[:, None])
+            return conf.any(axis=1)
+
+        hist_bad = jax.lax.cond(n_fin <= K, _hist_compact, _hist_full,
+                                operand=None)
+        pass1 = finishing & ~hist_bad
+        return self._active_writer_fixed_point(cfg, db, txn, finishing,
+                                               pass1)
+
+    def _active_writer_fixed_point(self, cfg: Config, db: dict,
+                                   txn: TxnState, finishing, pass1):
         # --- same-tick active-writer check (occ.cpp:185-233): serialize
         # this tick's finishers by ts.  Under the global semaphore a FAILED
         # validator removes itself from the active set before the next
@@ -69,6 +113,9 @@ class Occ(CCPlugin):
         # prefix-dependent greedy filter; compute its unique fixed point by
         # iterating "valid = pass1 & no earlier VALID writer conflicts"
         # (iteration n settles every conflict chain of depth <= n). ---
+        B, R = txn.keys.shape
+        ridx = jnp.arange(R, dtype=jnp.int32)[None, :]
+        valid_acc = finishing[:, None] & (ridx < txn.n_req[:, None])
         ent_live = (valid_acc & pass1[:, None]).reshape(-1)
         key = jnp.where(ent_live, txn.keys.reshape(-1), NULL_KEY)
         ts = jnp.broadcast_to(txn.ts[:, None], (B, R)).reshape(-1)

@@ -419,6 +419,53 @@ class TPCCWorkload(WorkloadPlugin):
 
     def apply_commit_entries(self, cfg: Config, tables: dict, key_local,
                              part, fields: dict, cts, live) -> dict:
+        """Apply commit effects (run_*_1/3/5/9 + insert_row analogs).
+
+        The effect entries are first COMPACTED: one (cts, idx) sort puts
+        them in a prefix, which is sliced to K lanes so every table
+        scatter, ring append, and the s_quantity chain runs at K lanes
+        instead of the full B*R entry width (26 scatters x 270k lanes cost
+        ~10 ms/tick at TPC-C shapes — PROFILE.md).  K covers 2x the
+        steady-state commit volume; a burst beyond it falls back to the
+        full-width body under lax.cond.  Both paths rank ring appends by
+        (cts, original idx), so they produce identical tables.
+        """
+        import jax.numpy as jnp
+
+        n = key_local.shape[0]
+        role_f = fields["role"]
+        eff = live & ((role_f & 7) != ROLE_NONE)
+        OOB = jnp.int32(2**31 - 1)
+        acap = cfg.admit_cap if cfg.admit_cap is not None else cfg.batch_size
+        K = min(n, max(16384, 2 * acap * 34))
+        if K >= n:
+            return self._apply_entries_body(cfg, tables, key_local, part,
+                                            role_f, fields["earg"],
+                                            fields["earg2"], cts, eff)
+
+        idx = jnp.arange(n, dtype=jnp.int32)
+        out = jax.lax.sort(
+            (jnp.where(eff, cts, OOB), idx, key_local, role_f,
+             fields["earg"], fields["earg2"], cts, eff.astype(jnp.int32)),
+            num_keys=2, is_stable=False)
+        c_key, c_rolef, c_earg, c_earg2, c_cts = (a[:K] for a in out[2:7])
+        c_eff = out[7][:K] == 1
+
+        def compact_path(t):
+            return self._apply_entries_body(cfg, t, c_key, part, c_rolef,
+                                            c_earg, c_earg2, c_cts, c_eff)
+
+        def full_path(t):
+            return self._apply_entries_body(cfg, t, key_local, part, role_f,
+                                            fields["earg"], fields["earg2"],
+                                            cts, eff)
+
+        n_eff = jnp.sum(eff.astype(jnp.int32))
+        return jax.lax.cond(n_eff <= K, compact_path, full_path, tables)
+
+    def _apply_entries_body(self, cfg: Config, tables: dict, key_local,
+                            part, role_f, earg_in, earg2_in, cts,
+                            eff) -> dict:
         import jax.numpy as jnp
         from deneva_tpu.ops import segment as seg
 
@@ -426,12 +473,11 @@ class TPCCWorkload(WorkloadPlugin):
         P = cfg.part_cnt
         t = dict(tables)
         n = key_local.shape[0]
-        role_f = fields["role"]
-        role = jnp.where(live, role_f & 7, ROLE_NONE)
+        role = jnp.where(eff, role_f & 7, ROLE_NONE)
         dw = role_f >> 3
         pay_d = (dw & 15) + 1
         pay_w = (dw >> 4) + 1
-        earg, earg2 = fields["earg"], fields["earg2"]
+        earg, earg2 = earg_in, earg2_in
         OOB = jnp.int32(2**31 - 1)
 
         def off(table, mask):
@@ -471,26 +517,37 @@ class TPCCWorkload(WorkloadPlugin):
             jnp.where(ms, remote, 0), mode="drop")
         # s_quantity (new_order_9, tpcc_txn.cpp:900-906): conditional
         # restock is not associative — apply same-row entries in cts rank
-        # order, one rank per while_loop round (within-tick multiplicity is
-        # tiny: 2PL forbids it entirely, T/O rarely exceeds 2)
+        # order (within-tick multiplicity is tiny: 2PL forbids it entirely,
+        # T/O rarely exceeds 2).  Sorted by (stock row, cts), same-row
+        # entries are ADJACENT: iterate ranks with each lane reading its
+        # predecessor's output via roll — ONE table gather and ONE scatter
+        # total, elementwise loop body (the old per-rank gather/scatter of
+        # the whole lane width dominated the TPC-C tick, PROFILE.md)
         skey = jnp.where(ms, key_local, OOB)
         idx = jnp.arange(n, dtype=jnp.int32)
-        (sk, _), (sidx,) = seg.sort_by((skey, cts), (idx,))
-        pos_sorted = seg.pos_in_segment(seg.segment_starts(sk))
-        rank = jnp.zeros(n, jnp.int32).at[sidx].set(pos_sorted)
-        max_rank = jnp.max(jnp.where(ms, rank, 0))
+        (sk, _), (sqty,) = seg.sort_by((skey, cts), (qty,))
+        sstarts = seg.segment_starts(sk)
+        spos = seg.pos_in_segment(sstarts)
+        slive = sk != OOB
+        max_rank = jnp.max(jnp.where(slive, spos, 0))
+        soff = jnp.where(slive, sk - cat.tables["STOCK"].base, 0)
+        sq0 = t["s_quantity"][soff]
 
         def body(carry):
-            r, sq = carry
-            sel = ms & (rank == r)
-            o = jnp.where(sel, key_local - cat.tables["STOCK"].base, OOB)
-            q = sq[jnp.where(sel, o, 0)]
-            newq = jnp.where(q > qty + 10, q - qty, q - qty + 91)
-            return r + 1, sq.at[o].set(jnp.where(sel, newq, 0), mode="drop")
+            r, qa = carry
+            q_in = jnp.where(spos == 0, sq0, jnp.roll(qa, 1))
+            newq = jnp.where(q_in > sqty + 10, q_in - sqty,
+                             q_in - sqty + 91)
+            return r + 1, jnp.where(slive & (spos == r), newq, qa)
 
-        _, s_quantity = jax.lax.while_loop(
-            lambda c: c[0] <= max_rank, body, (jnp.int32(0), t["s_quantity"]))
-        t["s_quantity"] = s_quantity
+        # init with sq0: every live lane is overwritten at its own rank
+        # iteration, and the carry must be varying-over-mesh under
+        # shard_map (a replicated zeros init fails the carry type check)
+        _, qa = jax.lax.while_loop(lambda c: c[0] <= max_rank, body,
+                                   (jnp.int32(0), sq0))
+        ends = jnp.roll(sstarts, -1).at[-1].set(True)
+        t["s_quantity"] = t["s_quantity"].at[
+            jnp.where(slive & ends, soff, OOB)].set(qa, mode="drop")
 
         # -- ring appends (deterministic: ordered by (cts, entry index)) --
         def ring_append(mask, cursor_key, cap, cols: dict):

@@ -265,3 +265,54 @@ class TestSharded:
         s2 = e2.run(30)
         for k in s1.tables:
             assert (np.asarray(s1.tables[k]) == np.asarray(s2.tables[k])).all(), k
+
+
+def test_apply_commit_entries_compact_equals_full():
+    """The K-lane compacted commit-effects path (apply_commit_entries) must
+    produce tables identical to the full-width body — the suite's normal
+    shapes short-circuit to the full body, so force compaction here
+    (n > K via a small admit_cap and a wide synthetic entry array)."""
+    import jax.numpy as jnp
+    from deneva_tpu.workloads import get as get_wl
+
+    cfg = tpcc_cfg(batch_size=512, admit_cap=16, num_wh=8)
+    wl = get_wl(cfg)
+    tables = wl.init_tables(cfg, 0)
+    rng = np.random.default_rng(7)
+
+    # synthetic effect entries spanning every role, with duplicates on
+    # stock/district rows; n chosen above K = max(16384, 2*16*34) = 16384
+    from deneva_tpu.workloads.tpcc import (ROLE_C_PAY, ROLE_D_NO,
+                                           ROLE_D_PAY, ROLE_NONE,
+                                           ROLE_S_NO, ROLE_W_PAY, catalog)
+    cat = catalog(cfg)
+    n = 17000
+    assert n > 16384
+    roles = rng.choice([ROLE_NONE, ROLE_W_PAY, ROLE_D_PAY, ROLE_C_PAY,
+                        ROLE_D_NO, ROLE_S_NO], size=n).astype(np.int32)
+    key = np.zeros(n, np.int32)
+    for role, tab in ((ROLE_W_PAY, "WAREHOUSE"), (ROLE_D_PAY, "DISTRICT"),
+                      (ROLE_C_PAY, "CUSTOMER"), (ROLE_D_NO, "DISTRICT"),
+                      (ROLE_S_NO, "STOCK")):
+        m = roles == role
+        ti = cat.tables[tab]
+        key[m] = ti.base + rng.integers(0, ti.n_local, int(m.sum()))
+    dw = rng.integers(0, 10, n).astype(np.int32) \
+        | (rng.integers(0, 8, n).astype(np.int32) << 4)
+    role_f = np.where(roles != ROLE_NONE, roles | (dw << 3), 0).astype(
+        np.int32)
+    earg = rng.integers(0, 1 << 10, n).astype(np.int32)
+    earg2 = rng.integers(0, 1 << 10, n).astype(np.int32)
+    cts = rng.permutation(n).astype(np.int32) + 1
+    live = roles != ROLE_NONE
+
+    fields = {"role": jnp.asarray(role_f), "earg": jnp.asarray(earg),
+              "earg2": jnp.asarray(earg2)}
+    out_compact = wl.apply_commit_entries(
+        cfg, tables, jnp.asarray(key), 0, fields, jnp.asarray(cts),
+        jnp.asarray(live))
+    out_full = wl._apply_entries_body(
+        cfg, tables, jnp.asarray(key), 0, fields["role"], fields["earg"],
+        fields["earg2"], jnp.asarray(cts), jnp.asarray(live))
+    for k in out_full:
+        assert (np.asarray(out_compact[k]) == np.asarray(out_full[k])).all(), k
