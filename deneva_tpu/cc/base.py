@@ -81,6 +81,11 @@ class CCPlugin:
     #: directional squeeze sees true per-row access order (single-access
     #: virtual txns have ridx 0).
     ship_access_tick: bool = False
+    #: net_delay mode: validation-aborted txns ship their entries through
+    #: the commit exchange with commit=0 so owners can clear prepare-phase
+    #: reservations (the RFIN(abort) release of a prepared participant,
+    #: worker_thread.cpp:302-343).  OCC sets this (its prepare marks).
+    release_on_vabort: bool = False
 
     def home_commit_check(self, cfg: Config, db: dict, txn: TxnState,
                           commit_try: jnp.ndarray) -> jnp.ndarray:
@@ -113,6 +118,14 @@ class CCPlugin:
 
     def on_abort(self, cfg: Config, db: dict, txn: TxnState,
                  aborted: jnp.ndarray) -> dict:
+        return db
+
+    def on_finalize_entries(self, cfg: Config, db: dict, keys: jnp.ndarray,
+                            cts: jnp.ndarray, live: jnp.ndarray) -> dict:
+        """Owner-side hook on every entry arriving through the commit
+        exchange (commit AND vabort-release), after on_commit: clear any
+        prepare-phase per-row reservations stamped with this txn's cts
+        (net_delay mode; no-op by default)."""
         return db
 
     def on_ts_rebase(self, cfg: Config, db: dict, shift: jnp.ndarray) -> dict:

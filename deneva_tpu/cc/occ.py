@@ -35,9 +35,40 @@ from deneva_tpu.ops import segment as seg
 class Occ(CCPlugin):
     name = "OCC"
     new_ts_on_restart = True
+    release_on_vabort = True   # prepare marks need the RFIN(abort) release
 
     def init_db(self, cfg: Config, n_rows: int, B: int, R: int) -> dict:
-        return {"occ_wcommit": jnp.full(n_rows, -1, jnp.int32)}
+        db = {"occ_wcommit": jnp.full(n_rows, -1, jnp.int32)}
+        if cfg.net_delay_ticks > 0:
+            # prepare-phase reservation (net_delay mode): a yes-voted
+            # validator's writes block later validators until its delayed
+            # commit/abort applies — the 2PC prepared state of the
+            # reference's distributed OCC (a validated txn stays in the
+            # active set until finish, occ.cpp:219-233).  occ_prep holds
+            # the pending validator's ts; occ_prep_until its expiry tick
+            # (vote transit + slack), so a release lost to routing overflow
+            # cannot block a row forever.
+            db["occ_prep"] = jnp.zeros(n_rows, jnp.int32)
+            db["occ_prep_until"] = jnp.zeros(n_rows, jnp.int32)
+        return db
+
+    def on_ts_rebase(self, cfg: Config, db: dict, shift) -> dict:
+        if "occ_prep" not in db:
+            return db
+        p = db["occ_prep"]
+        return {**db,
+                "occ_prep": jnp.where(p > 0, jnp.maximum(p - shift, 1), 0)}
+
+    def on_finalize_entries(self, cfg: Config, db: dict, keys, cts, live):
+        # clear my prepare marks at commit/abort finish (RFIN receipt)
+        if "occ_prep" not in db:
+            return db
+        n_rows = db["occ_prep"].shape[0]
+        kc = jnp.clip(keys, 0, n_rows - 1)
+        clear = live & (db["occ_prep"][kc] == cts)
+        prep = db["occ_prep"].at[jnp.where(clear, keys, NULL_KEY)].min(
+            0, mode="drop")
+        return {**db, "occ_prep": prep}
 
     def access(self, cfg: Config, db: dict, txn: TxnState, active):
         # optimistic work phase: every access proceeds immediately
@@ -68,7 +99,7 @@ class Occ(CCPlugin):
             conf = rmask & (db["occ_wcommit"][k] > txn.start_tick[:, None])
             pass1 = finishing & ~conf.any(axis=1)
             return self._active_writer_fixed_point(cfg, db, txn, finishing,
-                                                   pass1)
+                                                   pass1, tick)
         n_fin = jnp.sum(finishing.astype(jnp.int32))
         frank = jnp.cumsum(finishing.astype(jnp.int32)) \
             - finishing.astype(jnp.int32)
@@ -101,10 +132,10 @@ class Occ(CCPlugin):
                                 operand=None)
         pass1 = finishing & ~hist_bad
         return self._active_writer_fixed_point(cfg, db, txn, finishing,
-                                               pass1)
+                                               pass1, tick)
 
     def _active_writer_fixed_point(self, cfg: Config, db: dict,
-                                   txn: TxnState, finishing, pass1):
+                                   txn: TxnState, finishing, pass1, tick):
         # --- same-tick active-writer check (occ.cpp:185-233): serialize
         # this tick's finishers by ts.  Under the global semaphore a FAILED
         # validator removes itself from the active set before the next
@@ -116,6 +147,17 @@ class Occ(CCPlugin):
         B, R = txn.keys.shape
         ridx = jnp.arange(R, dtype=jnp.int32)[None, :]
         valid_acc = finishing[:, None] & (ridx < txn.n_req[:, None])
+        if "occ_prep" in db:
+            # prepare-mark conflict: a FOREIGN validator yes-voted a write
+            # on one of my rows and its delayed commit/abort is still in
+            # flight — conservative no-vote, like conflicting with a
+            # prepared active-set member (occ.cpp:185-199 across ticks)
+            n_rows = db["occ_prep"].shape[0]
+            kc = jnp.clip(txn.keys, 0, n_rows - 1)
+            prep = db["occ_prep"][kc]
+            pconf = valid_acc & (prep > 0) & (prep != txn.ts[:, None]) \
+                & (db["occ_prep_until"][kc] > tick)
+            pass1 = pass1 & ~pconf.any(axis=1)
         ent_live = (valid_acc & pass1[:, None]).reshape(-1)
         key = jnp.where(ent_live, txn.keys.reshape(-1), NULL_KEY)
         ts = jnp.broadcast_to(txn.ts[:, None], (B, R)).reshape(-1)
@@ -155,6 +197,18 @@ class Occ(CCPlugin):
         # axes under shard_map) matches the body output
         valid, _ = jax.lax.while_loop(
             lambda c: c[1], step, (pass1, jnp.any(pass1) | True))
+        if "occ_prep" in db:
+            # stamp prepare marks on the yes-voted write set (exclusive by
+            # construction: foreign-marked rows failed pconf above and two
+            # same-tick valid writers of one row are impossible — the fixed
+            # point serializes them)
+            wm = valid[:, None] & txn.is_write & (ridx < txn.n_req[:, None])
+            keysf = jnp.where(wm, txn.keys, NULL_KEY).reshape(-1)
+            db = {**db,
+                  "occ_prep": db["occ_prep"].at[keysf].set(
+                      ts, mode="drop"),
+                  "occ_prep_until": db["occ_prep_until"].at[keysf].set(
+                      tick + cfg.net_delay_ticks + 2, mode="drop")}
         return valid, db
 
     def on_commit(self, cfg: Config, db: dict, txn: TxnState, committed,

@@ -198,6 +198,23 @@ class Config:
     # --- multi-shard routing ---
     route_capacity_factor: float = 2.0  # per-(src,dst) all_to_all capacity slack
 
+    #: network cost model (the NETWORK_DELAY_TEST artificial delay,
+    #: system/msg_queue.cpp:81-124; per-message network time,
+    #: transport/message.h:51-57).  One-way message delay in scheduler
+    #: ticks: a remote access launched at tick t ships at t+D (request
+    #: transit), is arbitrated BINDINGLY by its owner then (locks/prewrites
+    #: take effect at the owner immediately, like the reference's owner-side
+    #: processing at message arrival), and the decision reaches the home
+    #: txn's state machine D ticks later — so a remote access costs 2D
+    #: ticks of latency and a multi-partition commit pays 2D more for the
+    #: 2PC prepare round trip, with locks held across the whole window
+    #: (the distributed tax the paper measures).  CALVIN instead gates
+    #: whole epochs by D (sequencer batch distribution) and pays D once at
+    #: finishing for remote-touching txns (RFWD forwarding), with no 2PC
+    #: vote round.  0 = same-tick resolution (the round-1..3 behavior).
+    #: Sharded engine only; local accesses always bypass.
+    net_delay_ticks: int = 0
+
     #: per-tick event trace depth (the DEBUG_TIMELINE analog,
     #: config.h:269 + scripts/timeline.py): when > 0, the engine records
     #: admissions / commits / aborts / waiting per tick for the first
@@ -229,6 +246,11 @@ class Config:
             assert self.cc_alg in (NO_WAIT, WAIT_DIE, TIMESTAMP), \
                 "sub_ticks refines NO_WAIT/WAIT_DIE/TIMESTAMP arbitration"
             assert self.acquire_window == 1, "sub_ticks needs window=1"
+        if self.net_delay_ticks > 0:
+            # delay models message transit between shards; a single node
+            # has no remote accesses for it to act on
+            assert self.node_cnt > 1, \
+                "net_delay_ticks needs a multi-node topology"
         assert self.part_cnt >= self.node_cnt and self.part_cnt % self.node_cnt == 0
         assert self.synth_table_size % self.part_cnt == 0
         # row ids must fit 30 bits: lock arbitration packs (row_id, kind)

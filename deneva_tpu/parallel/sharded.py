@@ -80,6 +80,26 @@ class ShardState(NamedTuple):
     tick: jnp.ndarray
     pool_cursor: jnp.ndarray
     ts_counter: jnp.ndarray
+    #: network-delay latches (Config.net_delay_ticks > 0; {} otherwise):
+    #:   launch      (B,)   tick the current request window was launched
+    #:   grant_tick  (B,R)  tick the owner granted the entry (BIG_TS: none)
+    #:   abort_due   (B,)   tick the owner's abort decision applies at home
+    #:   fin_ready   (B,)   tick the 2PC prepare may run (finish + transit)
+    #:   vote_tick   (B,)   tick votes were gathered (BIG_TS: not yet)
+    #:   vote_ok     (B,)   latched AND of owner votes + home check
+    net: dict = {}
+
+
+def _init_net(cfg: Config, B: int, R: int) -> dict:
+    if cfg.net_delay_ticks <= 0:
+        return {}
+    big = lambda *s: jnp.full(s, BIG_TS, jnp.int32)
+    return {"launch": jnp.zeros(B, jnp.int32),
+            "grant_tick": big(B, R),
+            "abort_due": big(B),
+            "fin_ready": big(B),
+            "vote_tick": big(B),
+            "vote_ok": jnp.zeros(B, dtype=bool)}
 
 
 def _flags(iw, held, req, fin):
@@ -154,6 +174,29 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
                        txn_type=txn_type, targs=targs, aux=aux)
         db = plugin.on_start(cfg, db, txn, free | expire)
 
+        # ---- network-delay latches: reset on a fresh attempt ----
+        dly = cfg.net_delay_ticks
+        if dly:
+            net = dict(state.net)
+            reset = free | expire
+            net["launch"] = jnp.where(reset, t, net["launch"])
+            net["grant_tick"] = jnp.where(reset[:, None], BIG_TS,
+                                          net["grant_tick"])
+            for k in ("abort_due", "fin_ready", "vote_tick"):
+                net[k] = jnp.where(reset, BIG_TS, net[k])
+            net["vote_ok"] = jnp.where(reset, False, net["vote_ok"])
+            # per-entry transit cost: CALVIN pays D on every entry (the
+            # sequencer's epoch batch reaches every scheduler one hop
+            # later, sequencer.cpp:283-326 — deterministic interleaving
+            # needs the COMPLETE epoch, so local entries wait too);
+            # otherwise only remote-owned rows pay
+            rem_e = (txn.keys % n_nodes) != node_id
+            delay_e = (jnp.full((B, R), dly, jnp.int32)
+                       if plugin.never_aborts
+                       else jnp.where(rem_e, dly, 0))
+        else:
+            net = state.net
+
         # ---- 2. build + route entries (exchange A) ----
         from deneva_tpu.config import READ_COMMITTED, READ_UNCOMMITTED
         from deneva_tpu.engine.state import make_entries
@@ -174,7 +217,35 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
                                                             READ_UNCOMMITTED)),
             window=R if plugin.request_all else cfg.acquire_window)
         held, req = ent.held, ent.req
-        fin2 = finishing[:, None] & (ridx < txn.n_req[:, None])
+        if dly:
+            # finish gate: a remote-touching txn's prepare request reaches
+            # its owners fin_delay ticks after it finishes executing; the
+            # votes come back vote_delay later (the 2PC round trip).
+            # CALVIN has no vote round — it pays the RFWD hop only.
+            has_rem = jnp.any(rem_e & (ridx < txn.n_req[:, None]), axis=1)
+            fin_delay = jnp.where(has_rem, dly, 0)
+            vote_delay = (jnp.zeros_like(fin_delay)
+                          if plugin.never_aborts else fin_delay)
+            net["fin_ready"] = jnp.where(
+                finishing & (net["fin_ready"] == BIG_TS),
+                t + fin_delay, net["fin_ready"])
+            validate_now = finishing & (t >= net["fin_ready"]) \
+                & (net["vote_tick"] == BIG_TS)
+            fin_flag = validate_now
+            # entry shipping reflects OWNER truth, undelayed: a granted
+            # in-flight entry is a held lock at its owner (ships held so
+            # arbitration stays consistent); a denied entry left the
+            # owner's queue (stops shipping — no ghost re-requests); a
+            # request still in transit has not arrived yet (launch gate)
+            granted_l = net["grant_tick"] < BIG_TS
+            launch_ok = t >= net["launch"][:, None] + delay_e
+            abort_pend = (net["abort_due"] < BIG_TS)[:, None]
+            reqBR = req.reshape(B, R) & launch_ok & ~granted_l & ~abort_pend
+            heldBR = held.reshape(B, R) | (granted_l & active[:, None])
+            held, req = heldBR.reshape(-1), reqBR.reshape(-1)
+        else:
+            fin_flag = finishing
+        fin2 = fin_flag[:, None] & (ridx < txn.n_req[:, None])
         live_e = held | req
 
         key_g = txn.keys.reshape(-1)
@@ -267,6 +338,16 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
         wait_e = ((decb >> 1) & 1) == 1
         abort_e = ((decb >> 2) & 1) == 1
         vote_e = ((decb >> 3) & 1) == 1
+        if dly:
+            # the owner's grant took effect at its end (the row is locked /
+            # the prewrite buffered from tick t), but the response reaches
+            # the home state machine delay_e ticks later
+            net["grant_tick"] = jnp.minimum(
+                net["grant_tick"], jnp.where(grant, t, BIG_TS))
+            grant_vis = (net["grant_tick"] < BIG_TS) \
+                & (t >= net["grant_tick"] + delay_e)
+        else:
+            grant_vis = grant
 
         for f in plugin.txn_db_fields:
             per_e = got[f][:nE].reshape(B, R)
@@ -282,20 +363,41 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
                      jnp.sum((ovf_txn & active).astype(jnp.int32)), measuring)
 
         votes_ok = jnp.all(vote_e | ~fin2, axis=1)
-        commit_try = finishing & votes_ok & ~ovf_txn
-        # coordinator re-validation once all owner votes are merged
-        # (worker_thread.cpp:302-343): per-owner constraints may be jointly
-        # unsatisfiable (e.g. MaaT merged [lower,upper) emptied)
-        commit_try = plugin.home_commit_check(cfg, db, txn, commit_try)
+        if dly:
+            # latch the vote round's outcome at the validation tick; the
+            # commit/abort decision applies vote_delay ticks later (the
+            # RACK_PREP transit home)
+            do_latch = validate_now & ~ovf_txn
+            latch_ok = plugin.home_commit_check(cfg, db, txn,
+                                                do_latch & votes_ok)
+            net["vote_tick"] = jnp.where(do_latch, t, net["vote_tick"])
+            net["vote_ok"] = jnp.where(do_latch, latch_ok, net["vote_ok"])
+            commit_due = finishing & (net["vote_tick"] < BIG_TS) \
+                & (t >= net["vote_tick"] + vote_delay) & ~ovf_txn
+            commit_try = commit_due & net["vote_ok"]
+            if plugin.commit_ts_field:
+                # merged bounds may have been squeezed during the vote
+                # transit (MaaT) — re-check before committing
+                commit_try = plugin.home_commit_check(cfg, db, txn,
+                                                      commit_try)
+            vabort_apply = commit_due & ~commit_try
+        else:
+            commit_try = finishing & votes_ok & ~ovf_txn
+            # coordinator re-validation once all owner votes are merged
+            # (worker_thread.cpp:302-343): per-owner constraints may be
+            # jointly unsatisfiable (e.g. MaaT merged [lower,upper) emptied)
+            commit_try = plugin.home_commit_check(cfg, db, txn, commit_try)
+            vabort_apply = finishing & ~commit_try & ~ovf_txn
         if plugin.never_aborts:
             # Calvin: a routing overflow defers the txn (retry next tick with
             # the same sequence number) — the abort path must stay closed
             vabort = jnp.zeros_like(finishing)
         else:
-            vabort = (finishing & ~commit_try & ~ovf_txn) | (ovf_txn & active)
+            vabort = vabort_apply | (ovf_txn & active)
 
         # cursor advance over granted prefix (as in the single-shard tick)
-        ok = grant | (ridx < txn.cursor[:, None]) | (ridx >= txn.n_req[:, None])
+        ok = grant_vis | (ridx < txn.cursor[:, None]) \
+            | (ridx >= txn.n_req[:, None])
         prefix = jnp.cumprod(ok.astype(jnp.int32), axis=1)
         new_cursor = jnp.minimum(jnp.sum(prefix, axis=1), txn.n_req)
         fail_pos = jnp.minimum(new_cursor, R - 1)[:, None]
@@ -306,18 +408,62 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
             has_req = has_req & ~ovf_txn
         blocked = has_req & (new_cursor < txn.n_req)
         wait = blocked & at_fail(wait_e) & ~vabort
-        abort_now = (blocked & at_fail(abort_e)) | vabort
+        if dly:
+            # latch the owner's abort decision; it reaches home (and the
+            # txn restarts) after the response transit.  The denied entry
+            # already stopped shipping (abort_pend above), so no ghost
+            # re-requests arbitrate meanwhile.
+            abort_raw = blocked & at_fail(abort_e)
+            rem_fail = jnp.any((delay_e > 0) & (ridx == fail_pos), axis=1)
+            net["abort_due"] = jnp.where(
+                abort_raw & (net["abort_due"] == BIG_TS),
+                t + jnp.where(rem_fail, dly, 0), net["abort_due"])
+            abort_now = (active & (net["abort_due"] <= t)) | vabort
+
+            # network-wait decomposition (per-message network time the
+            # reference carries in message.h:51-57): a txn is in the
+            # network iff its only obstacle this tick is message transit
+            cur_pos = txn.cursor[:, None]
+            cur_dly = jnp.max(jnp.where(ridx == cur_pos, delay_e, 0),
+                              axis=1)
+            gcur = jnp.min(jnp.where(ridx == cur_pos, net["grant_tick"],
+                                     BIG_TS), axis=1)
+            in_req = active & (txn.cursor < txn.n_req) & (gcur == BIG_TS) \
+                & (net["abort_due"] == BIG_TS) \
+                & (t < net["launch"] + cur_dly)
+            in_resp = active & (gcur < BIG_TS) & (t < gcur + cur_dly)
+            in_abt = active & (net["abort_due"] < BIG_TS) \
+                & (net["abort_due"] > t)
+            in_fin = finishing & (t < net["fin_ready"])
+            in_vote = finishing & (net["vote_tick"] < BIG_TS) \
+                & (t < net["vote_tick"] + vote_delay)
+            net_wait_cnt = jnp.sum((in_req | in_resp | in_abt | in_fin
+                                    | in_vote).astype(jnp.int32))
+        else:
+            abort_now = (blocked & at_fail(abort_e)) | vabort
 
         cursor = jnp.where(has_req & ~abort_now, new_cursor, txn.cursor)
         status = jnp.where(has_req & (new_cursor > txn.cursor),
                            STATUS_RUNNING, txn.status)
         status = jnp.where(wait, STATUS_WAITING, status)
+        if dly and not plugin.request_all:
+            # a cursor advance launches the next access (its request enters
+            # the network now).  request_all plugins (Calvin) launched
+            # every entry at admission — their requests are already queued
+            # at the owners, so the launch gate must not re-arm.
+            advanced = has_req & ~abort_now & (new_cursor > txn.cursor)
+            net["launch"] = jnp.where(advanced, t, net["launch"])
         stats = bump(stats, "twopl_wait_cnt",
                      jnp.sum(wait.astype(jnp.int32)), measuring)
 
         # ---- 5. commit exchange (B / RFIN): apply at owners ----
         cts = db[plugin.commit_ts_field] if plugin.commit_ts_field else txn.ts
-        commit_e = (commit_try[:, None] & (ridx < txn.n_req[:, None])).reshape(-1)
+        shipB = commit_try
+        if dly and plugin.release_on_vabort:
+            # validation-aborted txns ship their entries with commit=0 so
+            # owners release prepare marks (RFIN(abort))
+            shipB = commit_try | vabort_apply
+        commit_e = (shipB[:, None] & (ridx < txn.n_req[:, None])).reshape(-1)
         fieldsB = {
             "key": jnp.where(commit_e, key_l, NULL_KEY),
             "cts": jnp.broadcast_to(cts[:, None], (B, R)).reshape(-1),
@@ -328,7 +474,8 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
         ovfB_txn = jnp.any(ovfB.reshape(B, R), axis=1)
         commit = commit_try & ~ovfB_txn          # deferred txns retry RFIN
         stats = bump(stats, "commit_defer_cnt",
-                     jnp.sum(ovfB_txn.astype(jnp.int32)), measuring)
+                     jnp.sum((ovfB_txn & commit_try).astype(jnp.int32)),
+                     measuring)
         # re-gather the final commit flag so deferred txns' shipped entries
         # are ignored by the owner (no repack needed)
         cflag_flat = jnp.concatenate(
@@ -337,6 +484,17 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
         oB = origB.reshape(-1)
         sendB["commit"] = cflag_flat[jnp.where(oB >= 0, oB, nE)].astype(
             jnp.int32).reshape(n_nodes, cap)
+        if dly and plugin.release_on_vabort:
+            # final-disposition flag: 1 for entries of txns that COMMIT or
+            # RELEASE this tick; 0 for RFIN-deferred commits, whose prepare
+            # marks must survive the deferral window
+            final_txn = commit | vabort_apply
+            fflag_flat = jnp.concatenate(
+                [(final_txn[:, None]
+                  & (ridx < txn.n_req[:, None])).reshape(-1),
+                 jnp.zeros(1, bool)])
+            sendB["final"] = fflag_flat[jnp.where(oB >= 0, oB, nE)].astype(
+                jnp.int32).reshape(n_nodes, cap)
         if workload.has_effects:
             # per-entry effect args (the RFIN payload carrying the
             # workload's state-machine results to the row owners); computed
@@ -376,6 +534,10 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
             vdbB[plugin.commit_ts_field] = rB_cts
         vdbB = plugin.on_commit(cfg, vdbB, vtxnB, rB_commit,
                                 commit_ts=rB_cts, tick=t)
+        if dly and plugin.release_on_vabort:
+            fmask = (recvB["final"].reshape(-1) == 1) & (rB_key != NULL_KEY)
+            vdbB = plugin.on_finalize_entries(cfg, vdbB, rB_key, rB_cts,
+                                              fmask)
         db = {**db, **{k: v for k, v in vdbB.items()
                        if k not in plugin.txn_db_fields
                        and k != plugin.commit_ts_field}}
@@ -463,6 +625,13 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
         txn = txn._replace(status=status, cursor=cursor,
                            backoff_until=backoff_until, restarts=restarts2)
         db = plugin.on_abort(cfg, db, txn, abort_now | ua)
+        if dly:
+            done = commit | ua | abort_now
+            net["grant_tick"] = jnp.where(done[:, None], BIG_TS,
+                                          net["grant_tick"])
+            for k in ("abort_due", "fin_ready", "vote_tick"):
+                net[k] = jnp.where(done, BIG_TS, net[k])
+            net["vote_ok"] = jnp.where(done, False, net["vote_ok"])
 
         # latency decomposition integrals (txn-ticks per end-of-tick state;
         # network = entry-ticks shipped to remote owners this tick)
@@ -471,9 +640,19 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
             stats = trace_tick_events(
                 stats, t, n_free, n_commit,
                 jnp.sum(abort_now.astype(jnp.int32)), txn)
-        stats = bump(stats, "lat_network_time",
-                     jnp.sum((live_e & (dest != node_id)).astype(jnp.int32)),
-                     measuring)
+        if dly:
+            # with a real delay model, network time is the per-tick count
+            # of txns blocked purely on message transit (integrates to
+            # txn-ticks spent in the network, like the reference's
+            # message-carried network latency)
+            stats = bump(stats, "lat_network_time", net_wait_cnt, measuring)
+        else:
+            # D=0: no transit time exists; keep the traffic proxy
+            # (remote entries shipped this tick)
+            stats = bump(
+                stats, "lat_network_time",
+                jnp.sum((live_e & (dest != node_id)).astype(jnp.int32)),
+                measuring)
 
         # ---- 7. global ts rebase (all nodes together over ICI) ----
         limit = jnp.int32((3 << 29) // node_stride)
@@ -505,7 +684,7 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
         return ShardState(txn=txn, db=db, data=data, tables=tables,
                           stats=stats, tick=t + 1,
                           pool_cursor=(state.pool_cursor + n_free) % Q,
-                          ts_counter=ts_counter)
+                          ts_counter=ts_counter, net=net)
 
     return tick_fn
 
@@ -526,6 +705,12 @@ class ShardedEngine:
         if cfg.workload == TPCC:
             # commit_fields assigns o_id from the HOME-LOCAL district row
             assert cfg.first_part_local, "sharded TPC-C needs first_part_local"
+        if cfg.net_delay_ticks > 0:
+            # the delay latches track ONE outstanding access per txn
+            # (the reference's sequential state machine); greedy windows
+            # would overlap round trips the reference pays serially
+            assert cfg.acquire_window == 1 or self.plugin.request_all, \
+                "net_delay_ticks needs acquire_window=1"
         if pool is None:
             pool = self.workload.gen_pool(cfg)
         self.pool = pool
@@ -605,6 +790,7 @@ class ShardedEngine:
                 tick=jnp.zeros((), jnp.int32),
                 pool_cursor=jnp.zeros((), jnp.int32),
                 ts_counter=jnp.ones((), jnp.int32),
+                net=_init_net(cfg, B, R),
             )
 
         states = [one(p) for p in range(N)]

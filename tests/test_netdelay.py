@@ -1,0 +1,99 @@
+"""Network cost model (Config.net_delay_ticks): the rebuild of the
+reference's artificial message delay (system/msg_queue.cpp:81-124
+NETWORK_DELAY_TEST) and message-carried network latency
+(transport/message.h:51-57).
+
+Semantics under test: a remote access costs 2D ticks (request + response
+transit) with the owner's decision binding at arbitration time; a
+multi-partition commit pays 2D more for the 2PC prepare round; locks and
+prewrites stay held across the transit windows; local accesses bypass
+entirely.
+"""
+
+import numpy as np
+import pytest
+
+from deneva_tpu.config import Config
+from deneva_tpu.parallel.sharded import ShardedEngine
+
+BASE = dict(node_cnt=2, part_cnt=2, batch_size=64,
+            synth_table_size=1 << 12, req_per_query=4, zipf_theta=0.6,
+            query_pool_size=1 << 10, mpr=1.0, part_per_txn=2,
+            warmup_ticks=0)
+
+
+def _run(cfg, n_ticks=40):
+    eng = ShardedEngine(cfg)
+    st = eng.run(n_ticks)
+    s = eng.summary(st)
+    assert eng.global_data_sum(st) == s["write_cnt"], (cfg.cc_alg, "conservation")
+    return s
+
+
+@pytest.mark.parametrize("alg", ["NO_WAIT", "TIMESTAMP", "OCC", "MAAT",
+                                 "CALVIN"])
+def test_delay_conserves_and_commits(alg):
+    s = _run(Config(cc_alg=alg, net_delay_ticks=2, **BASE))
+    assert s["txn_cnt"] > 0
+    if alg == "CALVIN":
+        assert s["total_txn_abort_cnt"] == 0
+
+
+def test_latency_scales_with_delay():
+    """Commit latency must grow with D (each remote access pays the round
+    trip) and throughput at a fixed in-flight window must fall — the
+    paper's distributed tax."""
+    lat, tput = [], []
+    for D in (0, 1, 3):
+        s = _run(Config(cc_alg="NO_WAIT", net_delay_ticks=D, **BASE))
+        lat.append(s["avg_latency_ticks_short"])
+        tput.append(s["tput_per_tick"])
+    assert lat[0] < lat[1] < lat[2], lat
+    assert tput[0] > tput[1] > tput[2], tput
+    # R=4 accesses, ~half remote at part_per_txn=2: D=3 adds >= 8 ticks
+    assert lat[2] - lat[0] >= 8, lat
+
+
+def test_local_txns_bypass_delay_exactly():
+    """mpr=0 keeps every access home-local: the delay machinery must be
+    a bit-exact no-op (same commits/aborts as D=0)."""
+    kw = {**BASE, "mpr": 0.0, "part_per_txn": 1}
+    a = _run(Config(cc_alg="NO_WAIT", net_delay_ticks=0, **kw))
+    b = _run(Config(cc_alg="NO_WAIT", net_delay_ticks=4, **kw))
+    for k in ("txn_cnt", "total_txn_abort_cnt", "write_cnt"):
+        assert a[k] == b[k], (k, a[k], b[k])
+
+
+def test_network_time_integral():
+    """lat_network_time must integrate real transit waits when D > 0 and
+    scale with D."""
+    s1 = _run(Config(cc_alg="NO_WAIT", net_delay_ticks=1, **BASE))
+    s3 = _run(Config(cc_alg="NO_WAIT", net_delay_ticks=3, **BASE))
+    assert s1["lat_network_time"] > 0
+    # per-commit network share grows with D
+    n1 = s1["lat_network_time"] / max(s1["txn_cnt"], 1)
+    n3 = s3["lat_network_time"] / max(s3["txn_cnt"], 1)
+    assert n3 > 1.5 * n1, (n1, n3)
+
+
+def test_occ_prepare_marks_leak_free():
+    """Every UNEXPIRED prepare mark must belong to a txn whose vote round
+    is still in flight (vote latched, commit/abort pending) on some node —
+    anything else is a leaked reservation.  Expired marks are allowed
+    (that is the designed recovery for releases lost to exchange
+    overflow) because pconf ignores them."""
+    cfg = Config(cc_alg="OCC", net_delay_ticks=2, **BASE)
+    eng = ShardedEngine(cfg)
+    st = eng.run(40)
+    s = eng.summary(st)
+    assert s["txn_cnt"] > 0
+    tick = np.asarray(st.tick).max()
+    prep = np.asarray(st.db["occ_prep"]).reshape(-1)
+    until = np.asarray(st.db["occ_prep_until"]).reshape(-1)
+    live = (prep > 0) & (until > tick)
+    # txns with a vote in flight, across all nodes
+    vt = np.asarray(st.net["vote_tick"]).reshape(-1)
+    ts = np.asarray(st.txn.ts).reshape(-1)
+    inflight = set(ts[vt < np.int32(2**31 - 1)].tolist())
+    leaked = [int(p) for p in prep[live] if int(p) not in inflight]
+    assert not leaked, leaked
