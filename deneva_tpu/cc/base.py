@@ -128,6 +128,15 @@ class CCPlugin:
         (net_delay mode; no-op by default)."""
         return db
 
+    def on_prepared_entries(self, cfg: Config, db: dict, keys: jnp.ndarray,
+                            ts: jnp.ndarray, prepared: jnp.ndarray,
+                            tick) -> dict:
+        """Owner-side hook on entries flagged prepared (yes-voted, commit
+        in transit or RFIN-deferred): extend the prepare reservations'
+        expiry so a deferral of any length cannot outlive its marks
+        (net_delay mode; no-op by default)."""
+        return db
+
     def on_ts_rebase(self, cfg: Config, db: dict, shift: jnp.ndarray) -> dict:
         """Shift any timestamp-valued db arrays down by `shift` (the engine
         periodically rebases int32 timestamps to dodge wraparound)."""

@@ -59,6 +59,18 @@ class Occ(CCPlugin):
         return {**db,
                 "occ_prep": jnp.where(p > 0, jnp.maximum(p - shift, 1), 0)}
 
+    def on_prepared_entries(self, cfg: Config, db: dict, keys, ts,
+                            prepared, tick):
+        # keep my marks alive while my commit is in transit/deferred
+        if "occ_prep" not in db:
+            return db
+        n_rows = db["occ_prep"].shape[0]
+        kc = jnp.clip(keys, 0, n_rows - 1)
+        mine = prepared & (db["occ_prep"][kc] == ts)
+        until = db["occ_prep_until"].at[jnp.where(mine, keys, NULL_KEY)].max(
+            tick + cfg.net_delay_ticks + 2, mode="drop")
+        return {**db, "occ_prep_until": until}
+
     def on_finalize_entries(self, cfg: Config, db: dict, keys, cts, live):
         # clear my prepare marks at commit/abort finish (RFIN receipt)
         if "occ_prep" not in db:

@@ -58,6 +58,12 @@ class SeqTxn:
     n_req: int = 0
     # MaaT per-txn state (system/txn.h uncommitted_* sets, gr/gw snapshots)
     maat = None
+    # --- net_delay mode (Config.net_delay_ticks > 0) ---
+    arb_at: int = 0        # tick the current request reaches its owner
+    pend: tuple = None     # ("grant"|"abort", apply_tick) in response transit
+    fin_at: int = None     # tick the 2PC prepare may run
+    val: tuple = None      # (vote_ok, apply_tick) latched vote round
+    gdue: list = None      # CALVIN: per-access grant visibility ticks
 
 
 class Manager:
@@ -112,7 +118,11 @@ class LockManager(Manager):
         return "abort"
 
     def _release(self, txn):
-        for key in txn.keys[:txn.cursor]:
+        # iterate the full access set, not [:cursor]: in net_delay mode a
+        # grant can be bound at the owner while the response is still in
+        # transit (cursor not yet advanced) — removal is by slot id, so
+        # never-granted keys are a harmless no-op
+        for key in txn.keys[:txn.n_req]:
             k = int(key)
             if k in self.owners:
                 self.owners[k] = [o for o in self.owners[k]
@@ -277,13 +287,15 @@ class OccManager(Manager):
         self.wlast: dict[int, int] = {}    # key -> last committed-write tick
         self._tick_wsets: list = []        # same-tick validators' write sets
         self._tick = -1
+        # net_delay mode: yes-voted validators whose delayed commit/abort
+        # is still in flight stay in the active set (the engine's occ_prep
+        # prepare marks; occ.cpp:219-233 active-set semantics)
+        self.pending_val: dict[int, set] = {}   # tid -> write set
 
     def access(self, txn, key, iw):
         return "grant"                     # optimistic work phase
 
     def validate(self, txn, tick):
-        if tick != self._tick:
-            self._tick, self._tick_wsets = tick, []
         rset = {int(txn.keys[r]) for r in range(txn.n_req)
                 if not txn.is_write[r]}
         wset = {int(txn.keys[r]) for r in range(txn.n_req)
@@ -291,6 +303,17 @@ class OccManager(Manager):
         # history check (occ.cpp:167-180): reads vs later committed writes
         if any(self.wlast.get(k, -1) > txn.start_tick for k in rset):
             return False
+        if self.cfg.net_delay_ticks > 0:
+            # prepared-validator check: earlier validators (this tick in ts
+            # order, or any prior tick, commit still in flight) block on
+            # write-set intersection with my read AND write sets
+            for tid, w in self.pending_val.items():
+                if tid != txn.tid and w & (rset | wset):
+                    return False
+            self.pending_val[txn.tid] = wset
+            return True
+        if tick != self._tick:
+            self._tick, self._tick_wsets = tick, []
         # active-writer check (occ.cpp:185-199): earlier same-tick
         # validators' write sets vs my read AND write sets
         for w in self._tick_wsets:
@@ -300,9 +323,13 @@ class OccManager(Manager):
         return True
 
     def commit(self, txn, tick):
+        self.pending_val.pop(txn.tid, None)
         for r in range(txn.n_req):
             if txn.is_write[r]:
                 self.wlast[int(txn.keys[r])] = tick
+
+    def abort(self, txn):
+        self.pending_val.pop(txn.tid, None)
 
 
 @dataclasses.dataclass
@@ -518,8 +545,10 @@ class SequentialEngine:
     # -- driver protocol mirrors engine/scheduler.py's tick phases --
 
     def run(self, n_ticks: int):
+        tick = (self._tick_delay if self.cfg.net_delay_ticks > 0
+                else self._tick)
         for _ in range(n_ticks):
-            self._tick()
+            tick()
         return self
 
     def _draw_ts(self, node: int) -> int:
@@ -540,26 +569,35 @@ class SequentialEngine:
         self.pool_cursor[node] += 1
         return q
 
-    def _tick(self):
-        cfg, man, t = self.cfg, self.man, self.tick
+    def _expire_and_admit(self, t, delay: bool = False):
+        """Steps 1-2 shared by both tick drivers: backoff expiry (slot
+        order, like the batched cumsum ranks) then admission (per node in
+        slot order; epoch cap for Calvin).  delay=True additionally
+        initializes the net-transit fields (launch gate + latches)."""
+        cfg, man = self.cfg, self.man
         redraw = man.needs_new_ts_on_restart or cfg.restart_new_ts
+        calvin = cfg.cc_alg == "CALVIN"
 
-        # 1. backoff expiry (slot order, like the batched cumsum ranks)
+        def _net_init(txn):
+            txn.pend = txn.val = txn.fin_at = None
+            txn.gdue = [None] * txn.n_req if calvin else None
+            txn.arb_at = t + self._d(txn, txn.keys[0])
+
         for txn in self.txns:
             if txn.status == BACKOFF and txn.backoff_until <= t:
                 txn.status = RUNNING
                 txn.start_tick = t
                 if redraw:
                     txn.ts = self._draw_ts(txn.node)
+                if delay:
+                    _net_init(txn)
                 man.on_start(txn)
 
-        # 2. admission (per node in slot order; epoch cap for Calvin)
-        plugin_epoch = cfg.cc_alg == "CALVIN"
         admitted = [0] * self.N
         for txn in self.txns:
             if txn.status != FREE:
                 continue
-            if plugin_epoch and admitted[txn.node] >= cfg.epoch_size:
+            if calvin and admitted[txn.node] >= cfg.epoch_size:
                 continue
             q = self._pool_row(txn.node)
             txn.keys = self.pool.keys[q]
@@ -572,9 +610,15 @@ class SequentialEngine:
             txn.status = RUNNING
             txn.start_tick = t
             txn.ts = self._draw_ts(txn.node)
+            if delay:
+                _net_init(txn)
             admitted[txn.node] += 1
             self.stats["local_txn_start_cnt"] += 1
             man.on_start(txn)
+
+    def _tick(self):
+        cfg, man, t = self.cfg, self.man, self.tick
+        self._expire_and_admit(t)
 
         # 3/4. commit + access phases.  Phase ORDER differs by topology,
         # mirroring the two batched engines:
@@ -655,7 +699,132 @@ class SequentialEngine:
 
         self.tick += 1
 
+    # -- net_delay mode (Config.net_delay_ticks > 0, N-node) --
+
+    def _is_remote(self, txn, key) -> bool:
+        if self.cfg.cc_alg == "CALVIN":
+            # sequencer epoch distribution: every entry pays the hop
+            # (deterministic interleaving needs the COMPLETE epoch)
+            return True
+        return (int(key) % self.N) != txn.node
+
+    def _d(self, txn, key) -> int:
+        return self.cfg.net_delay_ticks if self._is_remote(txn, key) else 0
+
+    def _has_rem(self, txn) -> bool:
+        return any((int(txn.keys[r]) % self.N) != txn.node
+                   for r in range(txn.n_req))
+
+    def _tick_delay(self):
+        """Replays parallel/sharded.py's delayed tick: requests arbitrated
+        (bindingly) at launch + d, responses applied + d later, the 2PC
+        prepare at finish + d with the vote outcome applied + d more;
+        CALVIN pays d on every entry (epoch sync) and has no vote round.
+        Phase order matches the sharded engine: finish-gate observation
+        from start-of-tick cursors, access arbitration + validation
+        (exchange A), then response / commit application (A' / B)."""
+        cfg, man, t = self.cfg, self.man, self.tick
+        D = cfg.net_delay_ticks
+        calvin = cfg.cc_alg == "CALVIN"
+
+        # 1-2. backoff expiry + admission (shared with _tick)
+        self._expire_and_admit(t, delay=True)
+
+        # 3. finish-gate observation (start-of-tick cursors)
+        validating = []
+        for txn in self.txns:
+            if txn.status == RUNNING and txn.cursor >= txn.n_req \
+                    and txn.pend is None:
+                if txn.fin_at is None:
+                    txn.fin_at = t + (D if self._has_rem(txn) else 0)
+                if txn.fin_at <= t and txn.val is None:
+                    validating.append(txn)
+
+        # 4. access arbitration (exchange A), ts order; decisions bind at
+        # the owner now, the response enters transit
+        active = [x for x in self.txns
+                  if x.status in (RUNNING, WAITING) and x.cursor < x.n_req]
+        for txn in sorted(active, key=lambda x: x.ts):
+            if calvin:
+                if t < txn.arb_at:
+                    continue
+                for r in range(txn.cursor, txn.n_req):
+                    dec = man.access(txn, int(txn.keys[r]),
+                                     bool(txn.is_write[r]))
+                    if dec == "grant" and txn.gdue[r] is None:
+                        txn.gdue[r] = t + D
+                continue
+            if txn.pend is not None or t < txn.arb_at:
+                continue
+            r = txn.cursor
+            key = int(txn.keys[r])
+            dec = man.access(txn, key, bool(txn.is_write[r]))
+            if dec != "wait":   # wait: re-arbitrate next tick
+                txn.pend = (dec, t + self._d(txn, key))
+
+        # 5. validation (exchange A prepare), ts order; vote outcome
+        # applies after the response transit
+        for txn in sorted(validating, key=lambda x: x.ts):
+            ok = man.validate(txn, t)
+            vd = 0 if calvin else (D if self._has_rem(txn) else 0)
+            txn.val = (bool(ok), t + vd)
+
+        # 6. response application (exchange A')
+        for txn in self.txns:
+            if txn.status not in (RUNNING, WAITING):
+                continue
+            if calvin and txn.gdue is not None and txn.cursor < txn.n_req:
+                moved = False
+                while txn.cursor < txn.n_req \
+                        and txn.gdue[txn.cursor] is not None \
+                        and txn.gdue[txn.cursor] <= t:
+                    txn.cursor += 1
+                    moved = True
+                if moved:
+                    txn.status = RUNNING
+                elif t >= txn.arb_at:
+                    txn.status = WAITING
+                continue
+            if txn.pend is None:
+                continue
+            kind, due = txn.pend
+            if due > t:
+                continue
+            txn.pend = None
+            if kind == "grant":
+                txn.cursor += 1
+                txn.status = RUNNING
+                if txn.cursor < txn.n_req:
+                    txn.arb_at = t + max(
+                        1, self._d(txn, txn.keys[txn.cursor]))
+            else:
+                self._abort(txn)
+
+        # 7. commit / validation-abort application (exchange B), ts order
+        due_now = [x for x in self.txns
+                   if x.val is not None and x.val[1] <= t
+                   and x.status == RUNNING]
+        for txn in sorted(due_now, key=lambda x: x.ts):
+            ok, _ = txn.val
+            txn.val = None
+            txn.fin_at = None
+            if ok:
+                man.commit(txn, t)
+                for r in range(txn.n_req):
+                    if txn.is_write[r]:
+                        self.data[int(txn.keys[r])] += 1
+                        self.stats["write_cnt"] += 1
+                self.stats["txn_cnt"] += 1
+                if txn.restarts > 0:
+                    self.stats["unique_txn_abort_cnt"] += 1
+                txn.status = FREE
+            else:
+                self._abort(txn)
+
+        self.tick += 1
+
     def _abort(self, txn):
+        txn.pend = txn.val = txn.fin_at = None
         self.man.abort(txn)
         self.stats["total_txn_abort_cnt"] += 1
         shift = min(txn.restarts, 16)

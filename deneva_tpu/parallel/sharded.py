@@ -102,9 +102,14 @@ def _init_net(cfg: Config, B: int, R: int) -> dict:
             "vote_ok": jnp.zeros(B, dtype=bool)}
 
 
-def _flags(iw, held, req, fin):
-    return (iw.astype(jnp.int32) | (held.astype(jnp.int32) << 1)
-            | (req.astype(jnp.int32) << 2) | (fin.astype(jnp.int32) << 3))
+def _flags(iw, held, req, fin, prepared=None):
+    f = (iw.astype(jnp.int32) | (held.astype(jnp.int32) << 1)
+         | (req.astype(jnp.int32) << 2) | (fin.astype(jnp.int32) << 3))
+    if prepared is not None:
+        # net_delay mode: entries of a yes-voted txn awaiting its delayed
+        # (or RFIN-deferred) commit — owners keep their prepare marks fresh
+        f = f | (prepared.astype(jnp.int32) << 4)
+    return f
 
 
 def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
@@ -260,7 +265,12 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
         fields = {
             "key": jnp.where(live_e, key_l, NULL_KEY),
             "ts": ts_e,
-            "flags": _flags(ent.is_write, held, req, fin2.reshape(-1)),
+            "flags": _flags(
+                ent.is_write, held, req, fin2.reshape(-1),
+                prepared=(((net["vote_tick"] < BIG_TS)
+                           & net["vote_ok"])[:, None]
+                          & (ridx < txn.n_req[:, None])).reshape(-1)
+                if dly and plugin.release_on_vabort else None),
             "start_tick": stick.reshape(-1),
         }
         for f in plugin.txn_db_fields:
@@ -310,6 +320,14 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
         vactive = r_live
         dec, vdb = plugin.access(cfg, vdb, vtxn, vactive)
         votes, vdb = plugin.validate(cfg, vdb, vtxn, r_fin, t)
+        if dly and plugin.release_on_vabort:
+            # refresh prepare marks of yes-voted txns still awaiting their
+            # delayed/deferred commit, so expiry only ever reaps marks
+            # whose release was genuinely lost
+            r_prep = (((r_flags >> 4) & 1) == 1) & r_live
+            vdb = plugin.on_prepared_entries(cfg, vdb, r_key,
+                                             recv["ts"].reshape(-1),
+                                             r_prep, t)
 
         decbits = (dec.grant.reshape(-1).astype(jnp.int32)
                    | (dec.wait.reshape(-1).astype(jnp.int32) << 1)
