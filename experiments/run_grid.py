@@ -15,9 +15,14 @@ for XLA:CPU collective rendezvous deadlocks after many shard_map programs
 accumulate in one process.
 
 Grids mirror scripts/experiments.py:
-- ycsb_scaling  (:61-75):  NODE_CNT x CC_ALG, zipf 0.6, 50/50 rw
-- ycsb_skew     (:100-113): fixed nodes, zipf theta in {0 .. 0.9}
-- tpcc_scaling  (:303-341 map): TPC-C, NUM_WH scaled with nodes
+- ycsb_scaling     (:61-75):  NODE_CNT x CC_ALG, zipf 0.6, 50/50 rw
+- ycsb_skew        (:100-113): fixed nodes, zipf theta in {0 .. 0.9}
+- ycsb_network     (msg_queue.cpp:81-124): net_delay_ticks in {0,1,4}
+- ycsb_partitions  (:303-341): PART_PER_TXN sweep, strict_ppt
+- isolation_levels (config.h:336-340): the 4-level ladder, lock family
+- tpcc_scaling     (:303-341 map): TPC-C, NUM_WH ~ PART_CNT (contended)
+- tpcc_scaling2    (:303-341 map): NUM_WH scaled 16x/node (throughput)
+- pps_scaling      (:51-58): PPS, NODE_CNT x CC_ALG
 Row counts are scaled down from the paper's 16M/node to fit the CPU-mesh
 CI budget; the SHAPES of the curves (Calvin flat under contention, NO_WAIT
 collapsing at high theta) are the assertions, not absolute numbers
@@ -59,11 +64,16 @@ def base_cfg(**kw):
 
 
 def cells_for(grid: str, alg: str):
+    """Yield (cell_name, cfg, n_ticks) per grid slice.  TPC-C cells run
+    200 ticks: a NewOrder is up to ~33 sequential accesses (one per tick,
+    reference-faithful), so short runs cannot even complete txns that
+    abort once mid-program — the round-3 grids' degenerate 2PL cells were
+    mostly THIS length artifact, not CC behavior."""
     if grid == "ycsb_scaling":
         for n in SCALING_NODES:
             yield (f"{alg}-n{n}",
                    base_cfg(cc_alg=alg, node_cnt=n, part_cnt=n,
-                            synth_table_size=1 << 17))
+                            synth_table_size=1 << 17), N_TICKS)
     elif grid == "ycsb_skew":
         # table sized so the theta=0 baseline is conflict-light (the paper
         # grid uses 16M rows/node; 2^17 keeps the same qualitative regime
@@ -72,22 +82,77 @@ def cells_for(grid: str, alg: str):
             yield (f"{alg}-th{th}",
                    base_cfg(cc_alg=alg, node_cnt=SKEW_NODES,
                             part_cnt=SKEW_NODES, zipf_theta=th,
-                            synth_table_size=1 << 17))
+                            synth_table_size=1 << 17), N_TICKS)
+    elif grid == "ycsb_network":
+        # the distributed-tax sweep (NETWORK_DELAY_TEST,
+        # msg_queue.cpp:81-124): fixed 4-node mesh, one-way delay D in
+        # ticks; runs long enough that D=4's ~50-tick txn lifetimes reach
+        # steady state
+        for D in (0, 1, 4):
+            yield (f"{alg}-d{D}",
+                   base_cfg(cc_alg=alg, node_cnt=4, part_cnt=4,
+                            net_delay_ticks=D, synth_table_size=1 << 17,
+                            warmup_ticks=50), 150)
+    elif grid == "ycsb_partitions":
+        # PART_PER_TXN sweep (scripts/experiments.py:303-341
+        # ycsb_partitions): strict_ppt so each txn touches EXACTLY that
+        # many partitions
+        for ppt in (1, 2, 4, 8):
+            yield (f"{alg}-ppt{ppt}",
+                   base_cfg(cc_alg=alg, node_cnt=8, part_cnt=8,
+                            part_per_txn=ppt, strict_ppt=True,
+                            synth_table_size=1 << 17), N_TICKS)
+    elif grid == "isolation_levels":
+        # isolation ladder (config.h:336-340); meaningful for the lock
+        # family — other algorithms yield no cells
+        if alg in ("NO_WAIT", "WAIT_DIE"):
+            for lvl in ("SERIALIZABLE", "READ_COMMITTED",
+                        "READ_UNCOMMITTED", "NOLOCK"):
+                yield (f"{alg}-{lvl}",
+                       base_cfg(cc_alg=alg, node_cnt=4, part_cnt=4,
+                                isolation_level=lvl,
+                                synth_table_size=1 << 17), N_TICKS)
     elif grid == "tpcc_scaling":
+        # the reference's contended regime (NUM_WH ~ PART_CNT): few
+        # warehouses, every Payment/NewOrder colliding on wh + district
+        # rows.  batch_size throttled to 8/node — the reference runs 4
+        # worker threads/node (config.h THREAD_CNT), so B=32 in-flight
+        # txns/node was an operating point the reference never sees
         for n in SCALING_NODES:
             yield (f"{alg}-n{n}",
                    base_cfg(cc_alg=alg, workload="TPCC", node_cnt=n,
-                            part_cnt=n, num_wh=2 * n, batch_size=32,
+                            part_cnt=n, num_wh=2 * n, batch_size=8,
                             cust_per_dist=1000, max_items=64,
-                            synth_table_size=2048 * 8))
+                            warmup_ticks=50,
+                            synth_table_size=2048 * 8), 200)
+    elif grid == "tpcc_scaling2":
+        # the reference's scaled-warehouse regime (NUM_WH=128 x NODE_CNT,
+        # scripts/experiments.py:303-341) at CI scale: 16 wh/node keeps
+        # the same in-flight/warehouse ratio story — 2PL aborts < 0.6,
+        # commits comparable to the T/O family
+        for n in SCALING_NODES:
+            yield (f"{alg}-n{n}",
+                   base_cfg(cc_alg=alg, workload="TPCC", node_cnt=n,
+                            part_cnt=n, num_wh=16 * n, batch_size=8,
+                            cust_per_dist=1000, max_items=64,
+                            warmup_ticks=50,
+                            synth_table_size=2048 * 8), 200)
+    elif grid == "pps_scaling":
+        # PPS product-parts-supplier scaling (scripts/experiments.py:51-58)
+        for n in SCALING_NODES:
+            yield (f"{alg}-n{n}",
+                   base_cfg(cc_alg=alg, workload="PPS", node_cnt=n,
+                            part_cnt=n, batch_size=32,
+                            synth_table_size=1 << 14), 60)
     else:  # pragma: no cover
         raise ValueError(grid)
 
 
-GRIDS = ("ycsb_scaling", "ycsb_skew", "tpcc_scaling")
+GRIDS = ("ycsb_scaling", "ycsb_skew", "ycsb_network", "ycsb_partitions",
+         "isolation_levels", "tpcc_scaling", "tpcc_scaling2", "pps_scaling")
 
 
-def run_cell(cfg):
+def run_cell(cfg, n_ticks=N_TICKS):
     t0 = time.perf_counter()
     if cfg.node_cnt == 1:
         from deneva_tpu.engine.scheduler import Engine
@@ -97,7 +162,7 @@ def run_cell(cfg):
         eng = ShardedEngine(cfg)
     # one fused dispatch: with few host cores behind the virtual mesh,
     # per-tick dispatch churn can starve the XLA:CPU collective rendezvous
-    st = eng.run_compiled(N_TICKS)
+    st = eng.run_compiled(n_ticks)
     wall = time.perf_counter() - t0
     s = eng.summary(st)
     return ({k: v for k, v in s.items() if np.isscalar(v)},
@@ -111,8 +176,8 @@ def worker(grid: str, alg: str, idx: int):
     import jax
     jax.config.update("jax_platforms", "cpu")
 
-    cell_name, cfg = list(cells_for(grid, alg))[idx]
-    s, line = run_cell(cfg)
+    cell_name, cfg, n_ticks = list(cells_for(grid, alg))[idx]
+    s, line = run_cell(cfg, n_ticks)
     print(f"{grid}/{cell_name}: txn_cnt={s['txn_cnt']} "
           f"abort_rate={s['abort_rate']:.3f} "
           f"tput_per_tick={s['tput_per_tick']:.2f}", flush=True)
@@ -177,6 +242,58 @@ def qualitative_checks(all_rows: dict) -> list[str]:
             t1 = scal[f"{alg}-n1"]["txn_cnt"]
             t8 = scal[f"{alg}-n8"]["txn_cnt"]
             notes.append(f"{alg} total commits grow 1->8 nodes "
+                         f"({t1} -> {t8}): "
+                         f"{'OK' if t8 > t1 else 'UNEXPECTED'}")
+    net = all_rows.get("ycsb_network", {})
+    if net:
+        # the distributed tax: tput falls and latency rises with delay
+        for alg in ("NO_WAIT", "MAAT", "CALVIN"):
+            tp = [net[f"{alg}-d{d}"]["tput_per_tick"] for d in (0, 1, 4)]
+            lat = [net[f"{alg}-d{d}"]["avg_latency_ticks_short"]
+                   for d in (0, 1, 4)]
+            notes.append(
+                f"{alg} pays the network: tput/tick {tp[0]:.1f} -> "
+                f"{tp[1]:.1f} -> {tp[2]:.1f}, latency {lat[0]:.1f} -> "
+                f"{lat[1]:.1f} -> {lat[2]:.1f} ticks at D=0/1/4: "
+                f"{'OK' if tp[0] > tp[1] > tp[2] and lat[0] < lat[1] < lat[2] else 'UNEXPECTED'}")
+        nw = [net[f"NO_WAIT-d{d}"]["lat_network_time"] for d in (0, 1, 4)]
+        notes.append(
+            f"NO_WAIT network-wait integral grows with D "
+            f"({nw[1]:.0f} -> {nw[2]:.0f} txn-ticks at D=1/4): "
+            f"{'OK' if nw[2] > nw[1] > 0 else 'UNEXPECTED'}")
+    part = all_rows.get("ycsb_partitions", {})
+    if part:
+        for alg in ("NO_WAIT", "MAAT"):
+            tp = [part[f"{alg}-ppt{p}"]["tput_per_tick"] for p in (1, 2, 8)]
+            notes.append(
+                f"{alg} multi-partition cost: tput/tick {tp[0]:.1f} -> "
+                f"{tp[1]:.1f} -> {tp[2]:.1f} at 1/2/8 parts per txn: "
+                f"{'OK' if tp[0] >= tp[1] >= tp[2] else 'UNEXPECTED'}")
+    iso = all_rows.get("isolation_levels", {})
+    if iso:
+        ab = {lvl: iso[f"NO_WAIT-{lvl}"]["abort_rate"]
+              for lvl in ("SERIALIZABLE", "READ_COMMITTED", "NOLOCK")}
+        notes.append(
+            f"NO_WAIT abort rate falls as isolation weakens "
+            f"(SER {ab['SERIALIZABLE']:.3f} >= RC "
+            f"{ab['READ_COMMITTED']:.3f} >= NOLOCK {ab['NOLOCK']:.3f}): "
+            f"{'OK' if ab['SERIALIZABLE'] >= ab['READ_COMMITTED'] >= ab['NOLOCK'] else 'UNEXPECTED'}")
+    t2 = all_rows.get("tpcc_scaling2", {})
+    if t2:
+        for alg in ("NO_WAIT", "WAIT_DIE"):
+            a1 = t2[f"{alg}-n1"]["abort_rate"]
+            c1 = t2[f"{alg}-n1"]["txn_cnt"]
+            ts1 = t2["TIMESTAMP-n1"]["txn_cnt"]
+            notes.append(
+                f"{alg} tpcc_scaling2 n1: abort {a1:.3f} < 0.6 and commits "
+                f"{c1} within 2.5x of TIMESTAMP's {ts1}: "
+                f"{'OK' if a1 < 0.6 and c1 * 2.5 >= ts1 else 'UNEXPECTED'}")
+    pps = all_rows.get("pps_scaling", {})
+    if pps:
+        for alg in ("NO_WAIT", "CALVIN"):
+            t1 = pps[f"{alg}-n1"]["txn_cnt"]
+            t8 = pps[f"{alg}-n8"]["txn_cnt"]
+            notes.append(f"{alg} PPS commits grow 1->8 nodes "
                          f"({t1} -> {t8}): "
                          f"{'OK' if t8 > t1 else 'UNEXPECTED'}")
     return notes
