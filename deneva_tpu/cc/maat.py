@@ -67,7 +67,7 @@ class Maat(CCPlugin):
     ship_access_tick = True
 
     def init_db(self, cfg: Config, n_rows: int, B: int, R: int) -> dict:
-        return {
+        db = {
             "maat_lr": jnp.zeros(n_rows, jnp.int32),
             "maat_lw": jnp.zeros(n_rows, jnp.int32),
             "maat_lower": jnp.zeros(B, jnp.int32),
@@ -75,6 +75,12 @@ class Maat(CCPlugin):
             "maat_gw": jnp.zeros(B, jnp.int32),
             "maat_gr": jnp.zeros(B, jnp.int32),
         }
+        # NOTE a pending-ring deferral of the commit-time lr/lw scatters
+        # (the wr_ring pattern) was built and measured SLOWER here: the
+        # read-side join over a >=2*B*R-capacity ring costs ~1.4 ms and
+        # the flush cond copies both 64 MB carries (~1.9 ms) vs the
+        # ~2.4 ms the direct scatters cost (PROFILE.md round 4).
+        return db
 
     def on_start(self, cfg: Config, db: dict, txn: TxnState, started):
         # time_table.init (worker_thread.cpp:504-508): [0, MAX), fresh snaps
@@ -83,6 +89,21 @@ class Maat(CCPlugin):
                 "maat_upper": jnp.where(started, BIG_TS, db["maat_upper"]),
                 "maat_gw": jnp.where(started, 0, db["maat_gw"]),
                 "maat_gr": jnp.where(started, 0, db["maat_gr"])}
+
+    def on_ts_rebase(self, cfg: Config, db: dict, shift) -> dict:
+        # every MaaT db array is timestamp-valued; shift them with the
+        # engine's periodic rebase (0 stays "never", BIG_TS stays "open")
+        pos = lambda a: jnp.where(a > 0, jnp.maximum(a - shift, 1), 0)
+        out = {**db,
+               "maat_lr": pos(db["maat_lr"]),
+               "maat_lw": pos(db["maat_lw"]),
+               "maat_gw": pos(db["maat_gw"]),
+               "maat_gr": pos(db["maat_gr"]),
+               "maat_lower": jnp.maximum(db["maat_lower"] - shift, 0),
+               "maat_upper": jnp.where(db["maat_upper"] >= BIG_TS, BIG_TS,
+                                       jnp.maximum(db["maat_upper"] - shift,
+                                                   1))}
+        return out
 
     def access(self, cfg: Config, db: dict, txn: TxnState, active):
         B, R = txn.keys.shape
@@ -192,30 +213,36 @@ class Maat(CCPlugin):
             plr = seg.at_run_start(plr_full, run_start, starts, 0, "max")
             cap_e = jnp.where(s_fin, pmw, BIG_TS)
             push_e = jnp.where(s_fin & s_iw, plr, 0)
+            # ONE unpermute sort ships both reductions home
+            up_e, lo_e = seg.unpermute_many(s_orig, cap_e, push_e)
             upper_new = jnp.minimum(db["maat_upper"],
-                                    txn_reduce(s_orig, cap_e, "min"))
+                                    up_e.reshape(B, R).min(axis=1))
             lower_new = jnp.maximum(static_lower,
-                                    txn_reduce(s_orig, push_e, "max"))
+                                    lo_e.reshape(B, R).max(axis=1))
             return lower_new, upper_new
 
         def step(carry):
-            okv, lov, _ = carry
+            okv, lov, _up, _ = carry
             lower_new, upper_new = caps(okv, lov)
             new_ok = finishing & (lower_new < upper_new)
             changed = jnp.any(new_ok != okv) | jnp.any(lower_new != lov)
-            return new_ok, lower_new, changed
+            return new_ok, lower_new, upper_new, changed
 
-        # the initial `changed` carry must be constant True (enter the loop
-        # at least once) but ALSO must match the body output's
-        # varying-over-mesh type under shard_map: the body's `changed`
-        # depends on `finishing`, so a bare replicated True fails
-        # while_loop's carry type check on the sharded path.  The
-        # `| True` makes the value constant while `jnp.any(finishing)`
-        # supplies the type.
-        ok, lower, _ = jax.lax.while_loop(
-            lambda c: c[2], step,
-            (finishing, static_lower, jnp.any(finishing) | True))
-        lower, upper = caps(ok, lower)
+        # SPECULATIVE UNROLL (PROFILE.md): the ts-ordered chain usually
+        # settles in <= 2 iterations; unrolled steps fuse into the tick
+        # graph (no while-carry scoped-memory round trips) and the loop
+        # runs only for genuinely deeper chains.  `upper` rides the carry,
+        # so no extra caps() pass is needed after convergence: the loop
+        # exits exactly when a step reproduces its inputs.
+        ok, lower, upper, ch = step((finishing, static_lower,
+                                     db["maat_upper"],
+                                     jnp.any(finishing) | True))
+        ok, lower, upper, ch = step((ok, lower, upper, ch))
+        ok, lower, upper, _ = jax.lax.cond(
+            ch,
+            lambda op: jax.lax.while_loop(lambda c: c[3], step, op),
+            lambda op: op,
+            (ok, lower, upper, ch))
 
         # --- directional neighbor squeeze: consolidation of the validation
         # squeeze (maat.cpp:121-170) + commit-time forward validation
@@ -287,10 +314,11 @@ class Maat(CCPlugin):
         new_lo2 = jnp.where(run2 & w2, w_lo, 0)
         new_up2 = jnp.where(run2, jnp.where(w2, w_up, r_up), BIG_TS)
 
+        up_e2, lo_e2 = seg.unpermute_many(orig2, new_up2, new_lo2)
         upper_arr = jnp.minimum(db["maat_upper"],
-                                txn_reduce(orig2, new_up2, "min"))
+                                up_e2.reshape(B, R).min(axis=1))
         lower_arr = jnp.maximum(db["maat_lower"],
-                                txn_reduce(orig2, new_lo2, "max"))
+                                lo_e2.reshape(B, R).max(axis=1))
         # also persist the validators' own tightened bounds
         upper_arr = jnp.where(finishing, upper_v, upper_arr)
         lower_arr = jnp.where(finishing, lower, lower_arr)

@@ -194,12 +194,15 @@ class Mvcc(CCPlugin):
         # array — slice it to K lanes and gather only those rings
         (skey, _), (sts, slive) = seg.sort_by(
             (key, BIG_TS - ts), (ts, wmask))
-        # slice width: 2x the steady-state write-lane bound (admission cap
-        # x writes per txn) so only a multi-tick commit burst can straddle
-        # it — and a straddle folds into the floor (safe-abort direction),
-        # it cannot lose a committed write's visibility
+        # slice width: the steady-state write-lane bound (admission cap
+        # x writes per txn; commits/tick cannot exceed admissions/tick in
+        # steady state) so only a commit burst can straddle it — and a
+        # straddle folds into the floor (safe-abort direction), it cannot
+        # lose a committed write's visibility.  The ring gather below is
+        # K*H lanes (~2.7 ms at the old 2x width, PROFILE.md) — the
+        # dominant MVCC commit cost, so size it tight.
         acap = cfg.admit_cap if cfg.admit_cap is not None else B
-        K = min(skey.shape[0], max(8192, 2 * acap * R))
+        K = min(skey.shape[0], max(4096, acap * R))
         skeyK, stsK, sliveK = skey[:K], sts[:K], slive[:K]
         kk = jnp.clip(skeyK, 0, n_rows - 1)
         starts = seg.segment_starts(skeyK)

@@ -186,6 +186,30 @@ class Occ(CCPlugin):
         # my (key, txn)-run start
         run_start = starts | seg.segment_starts(s_tx)
 
+        if R == 1 and cfg.node_cnt > 1:
+            # Sharded virtual-txn context (every row of `txn` is one routed
+            # access entry; single-shard R==1 workloads have node_cnt==1
+            # and skip this — their ts-groups would all be singletons):
+            # the reference's active set is per NODE per txn
+            # (occ.cpp:219-233) — a validator failing ANY local check
+            # leaves this node's active set entirely.  Entries of one home
+            # txn share a globally unique ts, so aggregate per-entry
+            # verdicts over ts-runs: validity (and blocking power) becomes
+            # per-(owner, home txn), not per row.
+            gord = jnp.arange(B, dtype=jnp.int32)
+            gkey = jnp.where(finishing, txn.ts, NULL_KEY)
+            (g_sorted,), (g_orig,) = seg.sort_by((gkey,), (gord,))
+            gstarts = seg.segment_starts(g_sorted)
+
+            def group_and(ok_e):
+                bad = (finishing & ~ok_e).astype(jnp.int32)
+                _, _, s_bad = jax.lax.sort((gkey, gord, bad), num_keys=2,
+                                           is_stable=False)
+                g_bad = seg.seg_reduce(s_bad, gstarts, "max")
+                return finishing & seg.unpermute(g_orig, g_bad == 0)
+        else:
+            group_and = None
+
         def step(carry):
             valid, _ = carry
             # ship per-txn validity into sorted entry order by re-sorting
@@ -203,12 +227,18 @@ class Occ(CCPlugin):
                                         -1, "max")
             conflict = seg.unpermute(s_orig, live & (at_start > 0))
             new_valid = pass1 & ~conflict.reshape(B, R).any(axis=1)
+            if group_and is not None:
+                new_valid = group_and(new_valid)
             return new_valid, jnp.any(new_valid != valid)
 
         # initial changed=True derived from pass1 so its sharding (varying
-        # axes under shard_map) matches the body output
+        # axes under shard_map) matches the body output.  (A speculative
+        # 2-step unroll was measured SLOWER here — OCC's carry is one (B,)
+        # bool, so the while boundary is cheap and unrolled steps just add
+        # sorts; MAAT, whose carries are wide, keeps the unroll.)
+        valid0 = group_and(pass1) if group_and is not None else pass1
         valid, _ = jax.lax.while_loop(
-            lambda c: c[1], step, (pass1, jnp.any(pass1) | True))
+            lambda c: c[1], step, (valid0, jnp.any(pass1) | True))
         if "occ_prep" in db:
             # stamp prepare marks on the yes-voted write set (exclusive by
             # construction: foreign-marked rows failed pconf above and two

@@ -149,6 +149,16 @@ def seg_prefix_min(vals: jnp.ndarray, starts: jnp.ndarray,
     return _seg_scan(vals, starts, jnp.minimum, identity)
 
 
+def unpermute_many(perm: jnp.ndarray, *vals: jnp.ndarray):
+    """`unpermute` for several payloads with ONE sort — each extra operand
+    in a lax.sort is far cheaper than a second full sort (PROFILE.md)."""
+    conv = tuple(v.astype(jnp.int32) if v.dtype == jnp.bool_ else v
+                 for v in vals)
+    out = lax.sort((perm,) + conv, num_keys=1, is_stable=False)[1:]
+    return tuple(o == 1 if v.dtype == jnp.bool_ else o
+                 for o, v in zip(out, vals))
+
+
 def unpermute(perm: jnp.ndarray, vals: jnp.ndarray) -> jnp.ndarray:
     """Invert a permutation application: given values in permuted order and
     the original indices `perm` they came from, return values in original

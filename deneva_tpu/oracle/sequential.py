@@ -287,10 +287,13 @@ class OccManager(Manager):
         self.wlast: dict[int, int] = {}    # key -> last committed-write tick
         self._tick_wsets: list = []        # same-tick validators' write sets
         self._tick = -1
-        # net_delay mode: yes-voted validators whose delayed commit/abort
-        # is still in flight stay in the active set (the engine's occ_prep
-        # prepare marks; occ.cpp:219-233 active-set semantics)
-        self.pending_val: dict[int, set] = {}   # tid -> write set
+        # N>1: per-owner active-set state.  The reference's active set is
+        # per NODE (occ.cpp:219-233): a validator failing any LOCAL check
+        # leaves that node's active set but still blocks at nodes where it
+        # passed — until its global vote resolves.
+        self._owner_lists: dict[int, list] = {}  # owner -> same-tick wsets
+        self.row_marks: dict[int, int] = {}      # key -> tid (net_delay
+        #   prepare marks: commit/abort in flight)
 
     def access(self, txn, key, iw):
         return "grant"                     # optimistic work phase
@@ -300,18 +303,47 @@ class OccManager(Manager):
                 if not txn.is_write[r]}
         wset = {int(txn.keys[r]) for r in range(txn.n_req)
                 if txn.is_write[r]}
+        N = self.cfg.node_cnt
+        if N > 1:
+            # distributed validation: per-owner local verdicts, AND-ed at
+            # the coordinator (the sharded engine's per-(owner, home txn)
+            # grouped fixed point + prepare-mark pre-pass)
+            if tick != self._tick:
+                self._tick, self._owner_lists = tick, {}
+            by_owner: dict[int, list] = {}
+            for r in range(txn.n_req):
+                k = int(txn.keys[r])
+                by_owner.setdefault(k % N, []).append(
+                    (k, bool(txn.is_write[r])))
+            local_ok = {}
+            for o, krows in by_owner.items():
+                ok = True
+                for k, iw in krows:
+                    # history: reads vs later committed writes (local)
+                    if not iw and self.wlast.get(k, -1) > txn.start_tick:
+                        ok = False
+                    # cross-tick prepare marks (net_delay)
+                    m = self.row_marks.get(k)
+                    if m is not None and m != txn.tid:
+                        ok = False
+                keys_o = {k for k, _ in krows}
+                # same-tick earlier LOCALLY-valid writers at this owner
+                for w in self._owner_lists.get(o, []):
+                    if w & keys_o:
+                        ok = False
+                local_ok[o] = ok
+            for o, ok in local_ok.items():
+                if ok:
+                    w_o = {k for k, iw in by_owner[o] if iw}
+                    self._owner_lists.setdefault(o, []).append(w_o)
+                    if self.cfg.net_delay_ticks > 0:
+                        for k in w_o:
+                            self.row_marks[k] = txn.tid
+            return all(local_ok.values())
+        # single node: centralized validation under the global semaphore
         # history check (occ.cpp:167-180): reads vs later committed writes
         if any(self.wlast.get(k, -1) > txn.start_tick for k in rset):
             return False
-        if self.cfg.net_delay_ticks > 0:
-            # prepared-validator check: earlier validators (this tick in ts
-            # order, or any prior tick, commit still in flight) block on
-            # write-set intersection with my read AND write sets
-            for tid, w in self.pending_val.items():
-                if tid != txn.tid and w & (rset | wset):
-                    return False
-            self.pending_val[txn.tid] = wset
-            return True
         if tick != self._tick:
             self._tick, self._tick_wsets = tick, []
         # active-writer check (occ.cpp:185-199): earlier same-tick
@@ -322,14 +354,20 @@ class OccManager(Manager):
         self._tick_wsets.append(wset)
         return True
 
+    def _drop_marks(self, txn):
+        for r in range(txn.n_req):
+            k = int(txn.keys[r])
+            if self.row_marks.get(k) == txn.tid:
+                del self.row_marks[k]
+
     def commit(self, txn, tick):
-        self.pending_val.pop(txn.tid, None)
+        self._drop_marks(txn)
         for r in range(txn.n_req):
             if txn.is_write[r]:
                 self.wlast[int(txn.keys[r])] = tick
 
     def abort(self, txn):
-        self.pending_val.pop(txn.tid, None)
+        self._drop_marks(txn)
 
 
 @dataclasses.dataclass
@@ -583,6 +621,12 @@ class SequentialEngine:
             txn.gdue = [None] * txn.n_req if calvin else None
             txn.arb_at = t + self._d(txn, txn.keys[0])
 
+        # ONE slot-order pass for both expiry and admission: the batched
+        # engines draw timestamps with a single cumsum over
+        # ``need_ts = free | expire`` in slot order, so an admitted slot 3
+        # draws BEFORE a restarting slot 5 — interleaving the two loops
+        # must match that order or redraw-family (T/O) priorities skew
+        admitted = [0] * self.N
         for txn in self.txns:
             if txn.status == BACKOFF and txn.backoff_until <= t:
                 txn.status = RUNNING
@@ -592,29 +636,25 @@ class SequentialEngine:
                 if delay:
                     _net_init(txn)
                 man.on_start(txn)
-
-        admitted = [0] * self.N
-        for txn in self.txns:
-            if txn.status != FREE:
-                continue
-            if calvin and admitted[txn.node] >= cfg.epoch_size:
-                continue
-            q = self._pool_row(txn.node)
-            txn.keys = self.pool.keys[q]
-            txn.is_write = self.pool.is_write[q]
-            txn.n_req = int(self.pool.n_req[q])
-            txn.tid = self.next_tid
-            self.next_tid += 1
-            txn.cursor = 0
-            txn.restarts = 0
-            txn.status = RUNNING
-            txn.start_tick = t
-            txn.ts = self._draw_ts(txn.node)
-            if delay:
-                _net_init(txn)
-            admitted[txn.node] += 1
-            self.stats["local_txn_start_cnt"] += 1
-            man.on_start(txn)
+            elif txn.status == FREE:
+                if calvin and admitted[txn.node] >= cfg.epoch_size:
+                    continue
+                q = self._pool_row(txn.node)
+                txn.keys = self.pool.keys[q]
+                txn.is_write = self.pool.is_write[q]
+                txn.n_req = int(self.pool.n_req[q])
+                txn.tid = self.next_tid
+                self.next_tid += 1
+                txn.cursor = 0
+                txn.restarts = 0
+                txn.status = RUNNING
+                txn.start_tick = t
+                txn.ts = self._draw_ts(txn.node)
+                if delay:
+                    _net_init(txn)
+                admitted[txn.node] += 1
+                self.stats["local_txn_start_cnt"] += 1
+                man.on_start(txn)
 
     def _tick(self):
         cfg, man, t = self.cfg, self.man, self.tick
@@ -655,7 +695,15 @@ class SequentialEngine:
             commit_phase(fresh_finishing())
         snapshot = fresh_finishing() if self.N > 1 else None
 
-        # access phase (ts order, window accesses per txn)
+        # access phase (ts order, window accesses per txn).  In the N-node
+        # replay an access abort's lock releases are DEFERRED to tick end:
+        # the owner's abort decision travels home and the release messages
+        # travel back out (worker_thread.cpp:160-171 abort cleanup sends
+        # per-owner releases), so other owners see the locks freed next
+        # tick — exactly the sharded engine's entry-shipping timing.  The
+        # single-node replay releases inline (the worker thread frees its
+        # own locks in-process).
+        deferred_aborts = []
         active = [x for x in self.txns
                   if x.status in (RUNNING, WAITING)
                   and x.slot not in val_aborted and x.cursor < x.n_req]
@@ -685,13 +733,19 @@ class SequentialEngine:
                     txn.status = WAITING
                     break
                 else:
-                    self._abort(txn)
+                    if self.N > 1:
+                        deferred_aborts.append(txn)
+                    else:
+                        self._abort(txn)
                     break
 
         if self.N > 1:
             # sharded ordering: commit the txns that were finishing at tick
-            # START (their locks stayed held through this arbitration)
+            # START (their locks stayed held through this arbitration),
+            # then apply the deferred access-abort releases
             commit_phase(snapshot)
+            for txn in deferred_aborts:
+                self._abort(txn)
         elif cfg.commit_after_access:
             # post-access ordering: txns commit the same tick their last
             # access granted (Config.commit_after_access)

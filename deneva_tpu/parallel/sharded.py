@@ -254,7 +254,15 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
         live_e = held | req
 
         key_g = txn.keys.reshape(-1)
-        dest = jnp.where(live_e, key_g % n_nodes, n_nodes)
+        # LOCAL entries never touch the exchange: the reference's worker
+        # loop executes home-partition accesses directly (row_t::get_row in
+        # process) — only remote work rides nanomsg (msg_queue.cpp).  The
+        # owner kernel below processes received remote entries PLUS this
+        # node's own local entries side by side, so exchange capacity is
+        # sized for remote traffic only (an all-local workload previously
+        # funneled all B*R entries through the self-lane and overflowed).
+        local_e = live_e & (key_g % n_nodes == node_id)
+        dest = jnp.where(live_e & ~local_e, key_g % n_nodes, n_nodes)
         key_l = key_g // n_nodes
         ts_e = ent.ts
         stick = jnp.broadcast_to(txn.start_tick[:, None], (B, R))
@@ -279,63 +287,77 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
         # pack held entries first: dropping a held lock entry would hide it
         # from the owner; a dropped entry aborts its txn instead (a boolean
         # key, not an additive ts offset — that would overflow int32)
+        nE = B * R
         prio = (~held).astype(jnp.int32)
         send, orig, overflow = routing.pack_by_dest(
-            dest, prio, live_e, n_nodes, cap, fields)
+            dest, prio, live_e & ~local_e, n_nodes, cap, fields)
         stats = bump(stats, "remote_entry_cnt",
-                     jnp.sum((live_e & (dest != node_id)).astype(jnp.int32)),
+                     jnp.sum((live_e & ~local_e).astype(jnp.int32)),
                      measuring)
 
         recv = routing.exchange(send, AXIS)
 
         # ---- 3. owner side: virtual txns -> plugin kernels ----
-        Bv = n_nodes * cap
-        r_key = recv["key"].reshape(-1)
-        r_live = r_key != NULL_KEY
-        r_flags = recv["flags"].reshape(-1)
-        r_iw = (r_flags & 1) == 1
-        r_held = (r_flags >> 1) & 1 == 1
-        r_fin = ((r_flags >> 3) & 1 == 1) & r_live
+        # lanes [0, N*cap): received remote entries; [N*cap, N*cap+nE):
+        # this node's own local entries, processed in the same kernels
+        nR = n_nodes * cap
+        Bv = nR + nE
+
+        def owner_cat(recv_f, home_f, fill=0):
+            loc = jnp.where(local_e, home_f,
+                            jnp.asarray(fill, home_f.dtype))
+            return jnp.concatenate([recv_f.reshape(-1), loc])
+
+        o_key = owner_cat(recv["key"], jnp.where(local_e, key_l, NULL_KEY),
+                          NULL_KEY)
+        o_flags = owner_cat(recv["flags"], fields["flags"])
+        o_ts = owner_cat(recv["ts"], fields["ts"])
+        o_stick = owner_cat(recv["start_tick"], fields["start_tick"])
+        o_live = o_key != NULL_KEY
+        o_iw = (o_flags & 1) == 1
+        o_held = (o_flags >> 1) & 1 == 1
+        o_fin = ((o_flags >> 3) & 1 == 1) & o_live
 
         vtxn = TxnState(
-            status=jnp.where(r_live, STATUS_RUNNING, STATUS_FREE),
-            cursor=jnp.where(r_held, 1, 0),
-            ts=recv["ts"].reshape(-1),
+            status=jnp.where(o_live, STATUS_RUNNING, STATUS_FREE),
+            cursor=jnp.where(o_held, 1, 0),
+            ts=o_ts,
             pool_idx=jnp.zeros(Bv, jnp.int32),
             restarts=jnp.zeros(Bv, jnp.int32),
             backoff_until=jnp.zeros(Bv, jnp.int32),
-            start_tick=recv["start_tick"].reshape(-1),
-            first_start_tick=recv["start_tick"].reshape(-1),
-            keys=r_key[:, None],
-            is_write=r_iw[:, None],
-            n_req=jnp.where(r_live, 1, 0),
+            start_tick=o_stick,
+            first_start_tick=o_stick,
+            keys=o_key[:, None],
+            is_write=o_iw[:, None],
+            n_req=jnp.where(o_live, 1, 0),
             txn_type=jnp.zeros(Bv, jnp.int32),
             targs=jnp.zeros((Bv, 1), jnp.int32),
             aux=jnp.zeros((Bv, 1), jnp.int32),
         )
         vdb = dict(db)
         for f in plugin.txn_db_fields:
-            vdb[f] = recv[f].reshape(-1)
+            vdb[f] = owner_cat(recv[f], fields[f])
 
-        vactive = r_live
+        vactive = o_live
         dec, vdb = plugin.access(cfg, vdb, vtxn, vactive)
-        votes, vdb = plugin.validate(cfg, vdb, vtxn, r_fin, t)
+        votes, vdb = plugin.validate(cfg, vdb, vtxn, o_fin, t)
         if dly and plugin.release_on_vabort:
             # refresh prepare marks of yes-voted txns still awaiting their
             # delayed/deferred commit, so expiry only ever reaps marks
             # whose release was genuinely lost
-            r_prep = (((r_flags >> 4) & 1) == 1) & r_live
-            vdb = plugin.on_prepared_entries(cfg, vdb, r_key,
-                                             recv["ts"].reshape(-1),
-                                             r_prep, t)
+            o_prep = (((o_flags >> 4) & 1) == 1) & o_live
+            vdb = plugin.on_prepared_entries(cfg, vdb, o_key, o_ts,
+                                             o_prep, t)
 
         decbits = (dec.grant.reshape(-1).astype(jnp.int32)
                    | (dec.wait.reshape(-1).astype(jnp.int32) << 1)
                    | (dec.abort.reshape(-1).astype(jnp.int32) << 2)
                    | (votes.astype(jnp.int32) << 3))
-        back = {"decbits": decbits.reshape(n_nodes, cap)}
+        back = {"decbits": decbits[:nR].reshape(n_nodes, cap)}
         for f in plugin.txn_db_fields:
-            back[f] = vdb[f].reshape(n_nodes, cap)
+            back[f] = vdb[f][:nR].reshape(n_nodes, cap)
+        decb_loc = decbits[nR:]
+        vdb_loc = {f: vdb[f][nR:] for f in plugin.txn_db_fields}
         # keep owner-updated ROW arrays; txn-keyed fields travel back instead
         db = {**db, **{k: v for k, v in vdb.items()
                        if k not in plugin.txn_db_fields}}
@@ -343,7 +365,6 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
         ret = routing.exchange(back, AXIS)
 
         # ---- 4. home: unpack decisions, advance, vote-gather ----
-        nE = B * R
         defaults = {"decbits": jnp.zeros(nE + 1, jnp.int32).at[:].set(
             jnp.int32(1 << 3))}  # unshipped: no decision, vote=yes
         for f in plugin.txn_db_fields:
@@ -351,7 +372,8 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
                 [jnp.broadcast_to(db[f][:, None], (B, R)).reshape(-1),
                  jnp.zeros(1, db[f].dtype)])
         got = routing.unpack(ret, orig, nE, defaults)
-        decb = got["decbits"][:nE].reshape(B, R)
+        decb = jnp.where(local_e, decb_loc,
+                         got["decbits"][:nE]).reshape(B, R)
         grant = (decb & 1) == 1
         wait_e = ((decb >> 1) & 1) == 1
         abort_e = ((decb >> 2) & 1) == 1
@@ -368,7 +390,8 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
             grant_vis = grant
 
         for f in plugin.txn_db_fields:
-            per_e = got[f][:nE].reshape(B, R)
+            per_e = jnp.where(local_e, vdb_loc[f],
+                              got[f][:nE]).reshape(B, R)
             if plugin.txn_db_merge[f] == "max":
                 db = {**db, f: jnp.maximum(db[f], per_e.max(axis=1))}
             else:
@@ -482,13 +505,14 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
             # owners release prepare marks (RFIN(abort))
             shipB = commit_try | vabort_apply
         commit_e = (shipB[:, None] & (ridx < txn.n_req[:, None])).reshape(-1)
+        cts_e = jnp.broadcast_to(cts[:, None], (B, R)).reshape(-1)
         fieldsB = {
             "key": jnp.where(commit_e, key_l, NULL_KEY),
-            "cts": jnp.broadcast_to(cts[:, None], (B, R)).reshape(-1),
+            "cts": cts_e,
             "iw": txn.is_write.reshape(-1).astype(jnp.int32),
         }
         sendB, origB, ovfB = routing.pack_by_dest(
-            dest, ts_e, commit_e, n_nodes, cap, fieldsB)
+            dest, ts_e, commit_e & ~local_e, n_nodes, cap, fieldsB)
         ovfB_txn = jnp.any(ovfB.reshape(B, R), axis=1)
         commit = commit_try & ~ovfB_txn          # deferred txns retry RFIN
         stats = bump(stats, "commit_defer_cnt",
@@ -526,10 +550,20 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
                     n_nodes, cap)
 
         recvB = routing.exchange(sendB, AXIS)
-        rB_key = recvB["key"].reshape(-1)
-        rB_commit = (recvB["commit"].reshape(-1) == 1) & (rB_key != NULL_KEY)
-        rB_iw = recvB["iw"].reshape(-1) == 1
-        rB_cts = recvB["cts"].reshape(-1)
+        # owner view = received remote commit entries + my own local ones
+        # (local lanes use the FINAL commit/final masks directly — no
+        # re-gather needed, they never packed)
+        cfin_loc = cflag_flat[:nE] & local_e
+        rB_key = owner_cat(recvB["key"],
+                           jnp.where(commit_e & local_e, key_l, NULL_KEY),
+                           NULL_KEY)
+        rB_commit = jnp.concatenate(
+            [(recvB["commit"].reshape(-1) == 1)
+             & (recvB["key"].reshape(-1) != NULL_KEY),
+             cfin_loc])
+        rB_iw = owner_cat(recvB["iw"],
+                          txn.is_write.reshape(-1).astype(jnp.int32)) == 1
+        rB_cts = owner_cat(recvB["cts"], cts_e)
 
         vtxnB = TxnState(
             status=jnp.where(rB_commit, STATUS_RUNNING, STATUS_FREE),
@@ -553,7 +587,11 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
         vdbB = plugin.on_commit(cfg, vdbB, vtxnB, rB_commit,
                                 commit_ts=rB_cts, tick=t)
         if dly and plugin.release_on_vabort:
-            fmask = (recvB["final"].reshape(-1) == 1) & (rB_key != NULL_KEY)
+            ffin_loc = fflag_flat[:nE] & local_e
+            fmask = jnp.concatenate(
+                [(recvB["final"].reshape(-1) == 1)
+                 & (recvB["key"].reshape(-1) != NULL_KEY),
+                 ffin_loc])
             vdbB = plugin.on_finalize_entries(cfg, vdbB, rB_key, rB_cts,
                                               fmask)
         db = {**db, **{k: v for k, v in vdbB.items()
@@ -564,7 +602,8 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
         if workload.has_effects:
             tables = workload.apply_commit_entries(
                 cfg, tables, rB_key, node_id,
-                {f: recvB[f].reshape(-1) for f in workload.effect_fields},
+                {f: owner_cat(recvB[f], flds[f].reshape(-1))
+                 for f in workload.effect_fields},
                 rB_cts, rB_commit)
 
         # ---- command log + replication (home side) ----
@@ -669,7 +708,7 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
             # (remote entries shipped this tick)
             stats = bump(
                 stats, "lat_network_time",
-                jnp.sum((live_e & (dest != node_id)).astype(jnp.int32)),
+                jnp.sum((live_e & ~local_e).astype(jnp.int32)),
                 measuring)
 
         # ---- 7. global ts rebase (all nodes together over ICI) ----
