@@ -202,7 +202,13 @@ class Mvcc(CCPlugin):
         # K*H lanes (~2.7 ms at the old 2x width, PROFILE.md) — the
         # dominant MVCC commit cost, so size it tight.
         acap = cfg.admit_cap if cfg.admit_cap is not None else B
-        K = min(skey.shape[0], max(4096, acap * R))
+        # written rows per txn: TPC-C commits at most district + order +
+        # max_items_per_txn stock/orderline writes, far below its padded
+        # R=33 access width — the ring gather below is K*H lanes, so the
+        # bound directly sets the dominant commit cost
+        from deneva_tpu.config import TPCC
+        wpt = (cfg.max_items_per_txn + 2) if cfg.workload == TPCC else R
+        K = min(skey.shape[0], max(4096, acap * wpt))
         skeyK, stsK, sliveK = skey[:K], sts[:K], slive[:K]
         kk = jnp.clip(skeyK, 0, n_rows - 1)
         starts = seg.segment_starts(skeyK)

@@ -299,10 +299,6 @@ class OccManager(Manager):
         return "grant"                     # optimistic work phase
 
     def validate(self, txn, tick):
-        rset = {int(txn.keys[r]) for r in range(txn.n_req)
-                if not txn.is_write[r]}
-        wset = {int(txn.keys[r]) for r in range(txn.n_req)
-                if txn.is_write[r]}
         N = self.cfg.node_cnt
         if N > 1:
             # distributed validation: per-owner local verdicts, AND-ed at
@@ -341,6 +337,10 @@ class OccManager(Manager):
                             self.row_marks[k] = txn.tid
             return all(local_ok.values())
         # single node: centralized validation under the global semaphore
+        rset = {int(txn.keys[r]) for r in range(txn.n_req)
+                if not txn.is_write[r]}
+        wset = {int(txn.keys[r]) for r in range(txn.n_req)
+                if txn.is_write[r]}
         # history check (occ.cpp:167-180): reads vs later committed writes
         if any(self.wlast.get(k, -1) > txn.start_tick for k in rset):
             return False

@@ -93,6 +93,38 @@ def catalog(cfg: Config) -> Catalog:
     return cat
 
 
+#: legacy column name -> (block key, column index) for the packed 2-D
+#: blocks of init_tables (tests/tools address single columns through
+#: ring_view)
+RING_COLS = {
+    "c_balance": ("cust_block", 0), "c_ytd_payment": ("cust_block", 1),
+    "c_payment_cnt": ("cust_block", 2),
+    "s_ytd": ("stock_block", 0), "s_order_cnt": ("stock_block", 1),
+    "s_remote_cnt": ("stock_block", 2),
+    "h_c_id": ("hist_block", 0), "h_c_d_id": ("hist_block", 1),
+    "h_c_w_id": ("hist_block", 2), "h_d_id": ("hist_block", 3),
+    "h_w_id": ("hist_block", 4), "h_amount": ("hist_block", 5),
+    "o_id": ("ord_block", 0), "o_c_id": ("ord_block", 1),
+    "o_d_id": ("ord_block", 2), "o_w_id": ("ord_block", 3),
+    "o_ol_cnt": ("ord_block", 4), "o_all_local": ("ord_block", 5),
+    "no_o_id": ("ord_block", 6), "no_d_id": ("ord_block", 7),
+    "no_w_id": ("ord_block", 8),
+    "ol_o_id": ("ol_block", 0), "ol_d_id": ("ol_block", 1),
+    "ol_w_id": ("ol_block", 2), "ol_number": ("ol_block", 3),
+    "ol_i_id": ("ol_block", 4), "ol_supply_w_id": ("ol_block", 5),
+    "ol_quantity": ("ol_block", 6), "ol_amount": ("ol_block", 7),
+}
+
+
+def ring_view(tables: dict, col: str):
+    """Resolve a legacy single-column name against the packed block layout
+    (works for single-shard (cap, C) and sharded (N, cap, C) tables)."""
+    if col in RING_COLS:
+        blk, j = RING_COLS[col]
+        return tables[blk][..., j]
+    return tables[col]
+
+
 def _wh_local(w, P):
     """(w-1) // P: local warehouse index on shard wh_to_part(w)=(w-1)%P."""
     return (w - 1) // P
@@ -339,31 +371,38 @@ class TPCCWorkload(WorkloadPlugin):
         zi = lambda n: jnp.zeros(n, jnp.int32)
         ring = lambda n: jnp.zeros(n, jnp.int32)
         oc, olc, hc = cfg.tpcc_max_orders, cfg.tpcc_ol_cap, cfg.tpcc_hist_cap
+        # multi-column row state and insert rings are PACKED into 2-D
+        # blocks (one row per record): effect application then needs ONE
+        # row scatter per block instead of one point scatter per column —
+        # row scatters with a contiguous second dim vectorize (~0.05 ms
+        # per 8k rows) while ~23 separate 17k-lane point scatters are
+        # latency-bound (~3 ms of the TPC-C tick, PROFILE.md).  Legacy
+        # column names resolve through ring_view()/RING_COLS.
+        cust = jnp.broadcast_to(
+            jnp.asarray([-10, 10, 1], jnp.int32)[None, :],
+            (n_cust, 3))
         return {
             "w_ytd": jnp.full(wh_local, 300000, jnp.int32),
             "d_ytd": jnp.full(n_dist, 30000, jnp.int32),
             "d_next_o_id": jnp.full(n_dist, 3001, jnp.int32),
-            "c_balance": jnp.full(n_cust, -10, jnp.int32),
-            "c_ytd_payment": jnp.full(n_cust, 10, jnp.int32),
-            "c_payment_cnt": jnp.ones(n_cust, jnp.int32),
+            # [c_balance, c_ytd_payment, c_payment_cnt]
+            "cust_block": jnp.array(cust),
             "s_quantity": jnp.asarray(
                 rng.integers(10, 101, n_stock), jnp.int32),
-            "s_ytd": zi(n_stock),
-            "s_order_cnt": zi(n_stock),
-            "s_remote_cnt": zi(n_stock),
+            # [s_ytd, s_order_cnt, s_remote_cnt]
+            "stock_block": jnp.zeros((n_stock, 3), jnp.int32),
             # insert rings (preallocated; append at cursor, wrap at cap)
             "hist_cursor": jnp.zeros((), jnp.int32),
-            "h_c_id": ring(hc), "h_c_d_id": ring(hc), "h_c_w_id": ring(hc),
-            "h_d_id": ring(hc), "h_w_id": ring(hc), "h_amount": ring(hc),
+            # [h_c_id, h_c_d_id, h_c_w_id, h_d_id, h_w_id, h_amount]
+            "hist_block": jnp.zeros((hc, 6), jnp.int32),
             "order_cursor": jnp.zeros((), jnp.int32),
-            "o_id": ring(oc), "o_c_id": ring(oc), "o_d_id": ring(oc),
-            "o_w_id": ring(oc), "o_ol_cnt": ring(oc), "o_all_local": ring(oc),
-            "no_o_id": ring(oc), "no_d_id": ring(oc), "no_w_id": ring(oc),
+            # [o_id, o_c_id, o_d_id, o_w_id, o_ol_cnt, o_all_local,
+            #  no_o_id, no_d_id, no_w_id]
+            "ord_block": jnp.zeros((oc, 9), jnp.int32),
             "ol_cursor": jnp.zeros((), jnp.int32),
-            "ol_o_id": ring(olc), "ol_d_id": ring(olc), "ol_w_id": ring(olc),
-            "ol_number": ring(olc), "ol_i_id": ring(olc),
-            "ol_supply_w_id": ring(olc), "ol_quantity": ring(olc),
-            "ol_amount": ring(olc),
+            # [ol_o_id, ol_d_id, ol_w_id, ol_number, ol_i_id,
+            #  ol_supply_w_id, ol_quantity, ol_amount]
+            "ol_block": jnp.zeros((olc, 8), jnp.int32),
         }
 
     # ------------------------------------------------------------------
@@ -425,9 +464,10 @@ class TPCCWorkload(WorkloadPlugin):
         them in a prefix, which is sliced to K lanes so every table
         scatter, ring append, and the s_quantity chain runs at K lanes
         instead of the full B*R entry width (26 scatters x 270k lanes cost
-        ~10 ms/tick at TPC-C shapes — PROFILE.md).  K covers 2x the
-        steady-state commit volume; a burst beyond it falls back to the
-        full-width body under lax.cond.  Both paths rank ring appends by
+        ~10 ms/tick at TPC-C shapes — PROFILE.md).  K covers the
+        steady-state commit volume exactly (admissions/tick x max effect
+        roles per txn); a burst beyond it falls back to the full-width
+        body under lax.cond.  Both paths rank ring appends by
         (cts, original idx), so they produce identical tables.
         """
         import jax.numpy as jnp
@@ -437,7 +477,15 @@ class TPCCWorkload(WorkloadPlugin):
         eff = live & ((role_f & 7) != ROLE_NONE)
         OOB = jnp.int32(2**31 - 1)
         acap = cfg.admit_cap if cfg.admit_cap is not None else cfg.batch_size
-        K = min(n, max(16384, 2 * acap * 34))
+        # compact width: a txn has at most 1 + max_items_per_txn + 1 effect
+        # roles (NewOrder: D_NO + S_NO per line; Payment: 3), and commits
+        # per tick cannot exceed admissions in steady state — the old
+        # 2*acap*R bound (R = full access width, 34) ran the ~30-scatter
+        # effect body at 69k lanes instead of ~17k (14 ms -> ~4 ms of the
+        # TPC-C tick, PROFILE.md); bursts past K still fall back to the
+        # full-width body below, so tightness costs nothing but that rare
+        # tick
+        K = min(n, max(8192, acap * (cfg.max_items_per_txn + 2)))
         if K >= n:
             return self._apply_entries_body(cfg, tables, key_local, part,
                                             role_f, fields["earg"],
@@ -493,12 +541,10 @@ class TPCCWorkload(WorkloadPlugin):
             jnp.where(m, earg, 0), mode="drop")
         mc = role == ROLE_C_PAY
         co = off("CUSTOMER", mc)
-        t["c_balance"] = t["c_balance"].at[co].add(
-            jnp.where(mc, -earg, 0), mode="drop")
-        t["c_ytd_payment"] = t["c_ytd_payment"].at[co].add(
-            jnp.where(mc, earg, 0), mode="drop")
-        t["c_payment_cnt"] = t["c_payment_cnt"].at[co].add(
-            jnp.where(mc, 1, 0), mode="drop")
+        cpay = jnp.stack([jnp.where(mc, -earg, 0),
+                          jnp.where(mc, earg, 0),
+                          jnp.where(mc, 1, 0)], axis=1)
+        t["cust_block"] = t["cust_block"].at[co].add(cpay, mode="drop")
 
         # -- NewOrder: district next_o_id advance (additive) --
         md = role == ROLE_D_NO
@@ -510,11 +556,10 @@ class TPCCWorkload(WorkloadPlugin):
         so = off("STOCK", ms)
         qty = (earg & 15) + 1
         remote = (earg >> 4) & 1
-        t["s_ytd"] = t["s_ytd"].at[so].add(jnp.where(ms, qty, 0), mode="drop")
-        t["s_order_cnt"] = t["s_order_cnt"].at[so].add(
-            jnp.where(ms, 1, 0), mode="drop")
-        t["s_remote_cnt"] = t["s_remote_cnt"].at[so].add(
-            jnp.where(ms, remote, 0), mode="drop")
+        sadd = jnp.stack([jnp.where(ms, qty, 0),
+                          jnp.where(ms, 1, 0),
+                          jnp.where(ms, remote, 0)], axis=1)
+        t["stock_block"] = t["stock_block"].at[so].add(sadd, mode="drop")
         # s_quantity (new_order_9, tpcc_txn.cpp:900-906): conditional
         # restock is not associative — apply same-row entries in cts rank
         # order (within-tick multiplicity is tiny: 2PL forbids it entirely,
@@ -549,46 +594,45 @@ class TPCCWorkload(WorkloadPlugin):
         t["s_quantity"] = t["s_quantity"].at[
             jnp.where(slive & ends, soff, OOB)].set(qa, mode="drop")
 
-        # -- ring appends (deterministic: ordered by (cts, entry index)) --
-        def ring_append(mask, cursor_key, cap, cols: dict):
+        # -- ring appends (deterministic: ordered by (cts, entry index));
+        # one (n, C) row scatter per ring block --
+        def ring_append(mask, cursor_key, cap, block_key, cols: list):
             cnt = jnp.sum(mask.astype(jnp.int32))
             pri = jnp.where(mask, cts, OOB)
             (pk, _), (pidx,) = seg.sort_by((pri, idx), (idx,))
             r = jnp.zeros(n, jnp.int32).at[pidx].set(
                 jnp.arange(n, dtype=jnp.int32))
             pos = jnp.where(mask, (t[cursor_key] + r) % cap, cap)
-            for name, val in cols.items():
-                t[name] = t[name].at[pos].set(
-                    jnp.where(mask, val, 0), mode="drop")
+            payload = jnp.stack([jnp.where(mask, v, 0) for v in cols],
+                                axis=1)
+            t[block_key] = t[block_key].at[pos].set(payload, mode="drop")
             t[cursor_key] = t[cursor_key] + cnt
 
         # HISTORY at the customer's shard (run_payment_5: insert at
         # wh_to_part(c_w_id), tpcc_txn.cpp:688-700)
         cwl = co // (cfg.dist_per_wh * cfg.cust_per_dist)
         crem = co % (cfg.dist_per_wh * cfg.cust_per_dist)
-        ring_append(mc, "hist_cursor", cfg.tpcc_hist_cap, {
-            "h_c_id": crem % cfg.cust_per_dist + 1,
-            "h_c_d_id": crem // cfg.cust_per_dist + 1,
-            "h_c_w_id": cwl * P + part + 1,
-            "h_d_id": pay_d, "h_w_id": pay_w, "h_amount": earg,
-        })
+        ring_append(mc, "hist_cursor", cfg.tpcc_hist_cap, "hist_block", [
+            crem % cfg.cust_per_dist + 1,
+            crem // cfg.cust_per_dist + 1,
+            cwl * P + part + 1,
+            pay_d, pay_w, earg,
+        ])
         # ORDER + NEW-ORDER at the home warehouse's shard (new_order_5)
-        ring_append(md, "order_cursor", cfg.tpcc_max_orders, {
-            "o_id": earg2, "o_c_id": (earg & 0x3FFF) + 1,
-            "o_d_id": pay_d, "o_w_id": pay_w,
-            "o_ol_cnt": (earg >> 14) & 31,
-            "o_all_local": (earg >> 19) & 1,
-            "no_o_id": earg2, "no_d_id": pay_d, "no_w_id": pay_w,
-        })
+        ring_append(md, "order_cursor", cfg.tpcc_max_orders, "ord_block", [
+            earg2, (earg & 0x3FFF) + 1, pay_d, pay_w,
+            (earg >> 14) & 31, (earg >> 19) & 1,
+            earg2, pay_d, pay_w,
+        ])
         # ORDER-LINE at the supply warehouse's shard (new_order_9)
         swl = so // cfg.max_items
-        ring_append(ms, "ol_cursor", cfg.tpcc_ol_cap, {
-            "ol_o_id": earg2, "ol_d_id": pay_d, "ol_w_id": pay_w,
-            "ol_number": (earg >> 5) & 15,
-            "ol_i_id": so % cfg.max_items + 1,
-            "ol_supply_w_id": swl * P + part + 1,
-            "ol_quantity": qty, "ol_amount": jnp.zeros_like(earg),
-        })
+        ring_append(ms, "ol_cursor", cfg.tpcc_ol_cap, "ol_block", [
+            earg2, pay_d, pay_w,
+            (earg >> 5) & 15,
+            so % cfg.max_items + 1,
+            swl * P + part + 1,
+            qty, jnp.zeros_like(earg),
+        ])
         return t
 
     def user_abort(self, cfg: Config, txn, finishing):

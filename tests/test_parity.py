@@ -20,9 +20,11 @@ CFG = dict(batch_size=256, synth_table_size=1 << 16, req_per_query=10,
            warmup_ticks=0)
 
 # thresholds = PARITY.md measured divergence x ~1.5 noise headroom
+# (tightened round 4: the oracle's joint slot-order ts draws + deferred
+# N-node releases removed most systematic gaps)
 THRESH = {
-    "NO_WAIT": 0.025, "WAIT_DIE": 0.02, "TIMESTAMP": 0.01, "MVCC": 0.03,
-    "OCC": 0.01, "MAAT": 0.035, "CALVIN": 0.0,
+    "NO_WAIT": 0.02, "WAIT_DIE": 0.015, "TIMESTAMP": 0.008, "MVCC": 0.02,
+    "OCC": 0.005, "MAAT": 0.03, "CALVIN": 0.0,
 }
 
 
@@ -113,10 +115,14 @@ def test_tpcc_parity(alg):
 
 
 SHARDED_THRESH = {
-    # measured (PARITY.md multi-shard section) x ~1.5 headroom; the N-node
-    # oracle replays the sharded tick protocol (access-before-commit phase
-    # order = locks held through 2PC, node-interleaved ts, per-node pools)
-    "NO_WAIT": 0.03, "WAIT_DIE": 0.02, "MAAT": 0.04, "CALVIN": 0.0,
+    # The N-node oracle replays the sharded tick protocol exactly
+    # (access-before-commit phase order, next-tick release visibility,
+    # per-owner OCC verdicts, joint ts-draw order, local-entry bypass):
+    # measured divergence is 0 for six of seven algorithms at 2-8 nodes.
+    # MAAT's residual is the documented live-set approximation of
+    # access-time uncommitted-set snapshots (PARITY.md).
+    "NO_WAIT": 0.003, "WAIT_DIE": 0.003, "TIMESTAMP": 0.003, "MVCC": 0.003,
+    "OCC": 0.02, "MAAT": 0.05, "CALVIN": 0.0,
 }
 
 
@@ -132,6 +138,15 @@ def test_multi_shard_abort_rate_parity(alg, nodes):
     assert r["batched_conserved"] and r["sequential_conserved"], r
     assert r["abort_rate_divergence"] <= SHARDED_THRESH[alg], r
     assert 0.85 <= r["tput_ratio"] <= 1.2, r
+
+
+def test_occ_high_contention_exact():
+    """OCC at zipf 0.9 matches the oracle exactly (the joint ts-draw-order
+    oracle fix removed the last systematic gap)."""
+    r = run_pair(Config(cc_alg="OCC", **{**CFG, "zipf_theta": 0.9}),
+                 n_ticks=50)
+    assert r["batched_conserved"] and r["sequential_conserved"], r
+    assert r["abort_rate_divergence"] <= 0.005, r
 
 
 def test_calvin_identical_commit_counts():

@@ -29,8 +29,17 @@ def tpcc_cfg(**kw):
 
 
 def checksums(cfg, tables):
-    return {k: int(np.asarray(v, dtype=np.int64).sum())
-            for k, v in tables.items()}
+    """Per-logical-column sums: packed 2-D blocks expand to their legacy
+    column names (tpcc.RING_COLS) so conservation reads stay columnar."""
+    out = {}
+    blocks = {blk for blk, _ in tpcc.RING_COLS.values()}
+    for k, v in tables.items():
+        if k not in blocks:
+            out[k] = int(np.asarray(v, dtype=np.int64).sum())
+    for col in tpcc.RING_COLS:
+        out[col] = int(np.asarray(tpcc.ring_view(tables, col),
+                                  dtype=np.int64).sum())
+    return out
 
 
 def run_and_check(cfg, n_ticks=60):
@@ -139,9 +148,9 @@ class TestSingleShard:
         eng, st, s, init, fin = run_and_check(cfg)
         n = int(np.asarray(st.tables["order_cursor"]))
         assert n > 0
-        o_id = np.asarray(st.tables["o_id"])[:n]
-        o_d = np.asarray(st.tables["o_d_id"])[:n]
-        o_w = np.asarray(st.tables["o_w_id"])[:n]
+        o_id = np.asarray(tpcc.ring_view(st.tables, "o_id"))[:n]
+        o_d = np.asarray(tpcc.ring_view(st.tables, "o_d_id"))[:n]
+        o_w = np.asarray(tpcc.ring_view(st.tables, "o_w_id"))[:n]
         for (w, d) in set(zip(o_w.tolist(), o_d.tolist())):
             ids = np.sort(o_id[(o_w == w) & (o_d == d)])
             assert (np.diff(ids) == 1).all(), "o_ids not dense"
@@ -152,14 +161,14 @@ class TestSingleShard:
         eng, st, s, init, fin = run_and_check(cfg)
         n = int(np.asarray(st.tables["order_cursor"]))
         nl = int(np.asarray(st.tables["ol_cursor"]))
-        o_key = list(zip(np.asarray(st.tables["o_w_id"])[:n].tolist(),
-                         np.asarray(st.tables["o_d_id"])[:n].tolist(),
-                         np.asarray(st.tables["o_id"])[:n].tolist()))
-        o_cnt = np.asarray(st.tables["o_ol_cnt"])[:n]
-        ol_key = zip(np.asarray(st.tables["ol_w_id"])[:nl].tolist(),
-                     np.asarray(st.tables["ol_d_id"])[:nl].tolist(),
-                     np.asarray(st.tables["ol_o_id"])[:nl].tolist())
-        ol_num = np.asarray(st.tables["ol_number"])[:nl]
+        o_key = list(zip(np.asarray(tpcc.ring_view(st.tables, "o_w_id"))[:n].tolist(),
+                         np.asarray(tpcc.ring_view(st.tables, "o_d_id"))[:n].tolist(),
+                         np.asarray(tpcc.ring_view(st.tables, "o_id"))[:n].tolist()))
+        o_cnt = np.asarray(tpcc.ring_view(st.tables, "o_ol_cnt"))[:n]
+        ol_key = zip(np.asarray(tpcc.ring_view(st.tables, "ol_w_id"))[:nl].tolist(),
+                     np.asarray(tpcc.ring_view(st.tables, "ol_d_id"))[:nl].tolist(),
+                     np.asarray(tpcc.ring_view(st.tables, "ol_o_id"))[:nl].tolist())
+        ol_num = np.asarray(tpcc.ring_view(st.tables, "ol_number"))[:nl]
         counts = {}
         for k, num in zip(ol_key, ol_num.tolist()):
             counts.setdefault(k, set()).add(num)
@@ -247,7 +256,7 @@ class TestSharded:
         assert s["txn_cnt"] > 0
         assert s["remote_entry_cnt"] > 0
         dw = np.asarray(st.tables["w_ytd"]).sum(axis=1) - 300000 * 2
-        dc = -(np.asarray(st.tables["c_balance"], dtype=np.int64).sum(axis=1)
+        dc = -(np.asarray(tpcc.ring_view(st.tables, "c_balance"), dtype=np.int64).sum(axis=1)
                - (-10) * 2 * cfg.dist_per_wh * cfg.cust_per_dist)
         assert dw.sum() == dc.sum()
         hist = np.asarray(st.tables["hist_cursor"])
