@@ -158,7 +158,14 @@ class Maat(CCPlugin):
             """Broadcast per-txn (B,) values to entries and permute into
             this sort's order by re-sorting on the same fixed keys — on
             TPU one extra sort is ~4x cheaper than the per-lane
-            valid[s_tx]-style gathers it replaces (PROFILE.md)."""
+            valid[s_tx]-style gathers it replaces (PROFILE.md).
+
+            PRECONDITION: (key, ts) ties are intra-txn only — timestamps
+            are unique per live txn — so this is_stable=False re-sort can
+            only permute lanes WITHIN one txn's run, and only per-txn-
+            constant payloads may ship through it (a per-lane-varying
+            payload, or a future duplicate-ts scheme, would silently
+            misalign tie groups; checked when debug_invariants is on)."""
             pay = tuple(jnp.broadcast_to(v[:, None].astype(jnp.int32),
                                          (B, R)).reshape(-1)
                         for v in vals_B)
@@ -290,6 +297,8 @@ class Maat(CCPlugin):
                  "min")
         upper_v = jnp.where(ok, jnp.maximum(jnp.minimum(upper, adj),
                                             lower + 1), upper)
+        # re-sort shipping (same precondition as to_sorted: ts unique per
+        # txn, payload per-txn-constant)
         _, _, _, up2c = jax.lax.sort((key, atick, ts, bcast(upper_v)),
                                      num_keys=3, is_stable=False)
 

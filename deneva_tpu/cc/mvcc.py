@@ -79,6 +79,12 @@ class Mvcc(CCPlugin):
             "r_ring": jnp.zeros(n_rows * H, jnp.int32),
             "rts0": jnp.zeros(n_rows, jnp.int32),
             "w_floor": jnp.zeros(n_rows, jnp.int32),
+            # committed writes folded into the floor because a commit
+            # burst straddled the K-lane merge slice (safe-abort
+            # direction, but a source of abort bias — parity runs check
+            # this stayed 0; a full-width lax.cond fallback was rejected
+            # because the cond would carry both 512 MB rings)
+            "mvcc_tail_fold_cnt": jnp.zeros((), jnp.int32),
         }
 
     def on_ts_rebase(self, cfg: Config, db: dict, shift) -> dict:
@@ -241,17 +247,23 @@ class Mvcc(CCPlugin):
 
         # >K committed write lanes in one tick (needs > 8192; admission is
         # capped far below): fold the overflow into the floor (safe-abort
-        # direction), only when it actually happens
+        # direction), only when it actually happens — and COUNT it, so a
+        # run can prove its results never took the fold bias
+        fold_cnt = db["mvcc_tail_fold_cnt"]
         if skey.shape[0] > K:
             tail_live = slive[K:]
 
-            def _fold(fl):
-                return fl.at[jnp.where(tail_live,
-                                       jnp.clip(skey[K:], 0, n_rows - 1),
-                                       n_rows)].max(sts[K:], mode="drop")
+            def _fold(op):
+                fl, c = op
+                fl = fl.at[jnp.where(tail_live,
+                                     jnp.clip(skey[K:], 0, n_rows - 1),
+                                     n_rows)].max(sts[K:], mode="drop")
+                return fl, c + jnp.sum(tail_live.astype(jnp.int32))
 
-            w_floor = jax.lax.cond(jnp.any(tail_live), _fold,
-                                   lambda fl: fl, w_floor)
-        return {**db, "w_ring": w_ring, "r_ring": r_ring, "w_floor": w_floor}
+            w_floor, fold_cnt = jax.lax.cond(
+                jnp.any(tail_live), _fold, lambda op: op,
+                (w_floor, fold_cnt))
+        return {**db, "w_ring": w_ring, "r_ring": r_ring,
+                "w_floor": w_floor, "mvcc_tail_fold_cnt": fold_cnt}
 
 

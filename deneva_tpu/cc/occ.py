@@ -115,8 +115,12 @@ class Occ(CCPlugin):
         n_fin = jnp.sum(finishing.astype(jnp.int32))
         frank = jnp.cumsum(finishing.astype(jnp.int32)) \
             - finishing.astype(jnp.int32)
+        # dead lanes map past K + B so indices stay GLOBALLY unique even
+        # when a >K finishing burst pushes finisher ranks into [K, B)
+        # (both ranges drop; unique_indices=True must hold regardless,
+        # the cond below only selects which result is used)
         rowpos = jnp.where(finishing, frank,
-                           K + jnp.arange(B, dtype=jnp.int32))
+                           K + B + jnp.arange(B, dtype=jnp.int32))
         buf_keys = jnp.full((K, R), NULL_KEY, jnp.int32).at[rowpos].set(
             jnp.where(rmask, txn.keys, NULL_KEY), mode="drop",
             unique_indices=True)
