@@ -80,6 +80,13 @@ class Maat(CCPlugin):
         # read-side join over a >=2*B*R-capacity ring costs ~1.4 ms and
         # the flush cond copies both 64 MB carries (~1.9 ms) vs the
         # ~2.4 ms the direct scatters cost (PROFILE.md round 4).
+
+        # validation case counters (the maat_case1-6 families of
+        # maat.cpp:46-111 / statistics/stats.h), warmup-gated like
+        # INC_STATS; db scalars ending in _cnt surface into [summary]
+        for k in ("maat_case1_cnt", "maat_case2_cnt", "maat_case3_cnt",
+                  "maat_case4_cnt", "maat_case6_cnt"):
+            db[k] = jnp.zeros((), jnp.int32)
         return db
 
     def on_start(self, cfg: Config, db: dict, txn: TxnState, started):
@@ -181,7 +188,9 @@ class Maat(CCPlugin):
         # cases 1/3: lower above the greatest committed write/read ts seen
         # at access time (snapshots).  Independent of same-tick neighbors.
         lower = jnp.maximum(db["maat_lower"], db["maat_gw"] + 1)
+        case1 = finishing & (db["maat_lower"] <= db["maat_gw"])
         has_write = (txn.is_write & granted).any(axis=1)
+        case3 = finishing & has_write & (lower <= db["maat_gr"])
         lower = jnp.where(finishing & has_write,
                           jnp.maximum(lower, db["maat_gr"] + 1), lower)
 
@@ -250,6 +259,35 @@ class Maat(CCPlugin):
             lambda op: jax.lax.while_loop(lambda c: c[3], step, op),
             lambda op: op,
             (ok, lower, upper, ch))
+
+        # case counters (maat.cpp:46-111 families): 1/3 snapshot pushes,
+        # 2 = upper capped by earlier validated writers, 4 = lower pushed
+        # by earlier validated readers, 6 = range emptied (abort).  Bumped
+        # once per VALIDATION EVENT: in the sharded virtual-entry context
+        # (R==1, entries of one home txn share a unique ts) a
+        # representative-entry mask keeps counts per (owner, txn), not
+        # per routed access; its per-entry bound values sample one owner
+        # view, like the reference's per-node validate.
+        measuring = tick >= cfg.warmup_ticks
+        if R == 1 and cfg.node_cnt > 1:
+            gord = jnp.arange(B, dtype=jnp.int32)
+            gkey = jnp.where(finishing, txn.ts, NULL_KEY)
+            (g_sorted,), (g_orig,) = seg.sort_by((gkey,), (gord,))
+            rep = seg.unpermute(
+                g_orig, seg.segment_starts(g_sorted)) & finishing
+        else:
+            rep = finishing
+        cnt = lambda m: jnp.where(measuring,
+                                  jnp.sum((m & rep).astype(jnp.int32)), 0)
+        case_inc = {
+            "maat_case1_cnt": db["maat_case1_cnt"] + cnt(case1),
+            "maat_case3_cnt": db["maat_case3_cnt"] + cnt(case3),
+            "maat_case2_cnt": db["maat_case2_cnt"]
+            + cnt(upper < db["maat_upper"]),
+            "maat_case4_cnt": db["maat_case4_cnt"]
+            + cnt(lower > static_lower),
+            "maat_case6_cnt": db["maat_case6_cnt"] + cnt(~ok),
+        }
 
         # --- directional neighbor squeeze: consolidation of the validation
         # squeeze (maat.cpp:121-170) + commit-time forward validation
@@ -332,7 +370,8 @@ class Maat(CCPlugin):
         upper_arr = jnp.where(finishing, upper_v, upper_arr)
         lower_arr = jnp.where(finishing, lower, lower_arr)
 
-        return ok, {**db, "maat_lower": lower_arr, "maat_upper": upper_arr}
+        return ok, {**db, **case_inc,
+                    "maat_lower": lower_arr, "maat_upper": upper_arr}
 
     def home_commit_check(self, cfg: Config, db: dict, txn: TxnState,
                           commit_try):

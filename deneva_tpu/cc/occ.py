@@ -38,7 +38,12 @@ class Occ(CCPlugin):
     release_on_vabort = True   # prepare marks need the RFIN(abort) release
 
     def init_db(self, cfg: Config, n_rows: int, B: int, R: int) -> dict:
-        db = {"occ_wcommit": jnp.full(n_rows, -1, jnp.int32)}
+        db = {"occ_wcommit": jnp.full(n_rows, -1, jnp.int32),
+              # validation outcome counters (the occ_check/abort families
+              # of statistics/stats.h): history-check failures vs
+              # active-set conflicts; warmup-gated, surfaced in [summary]
+              "occ_hist_abort_cnt": jnp.zeros((), jnp.int32),
+              "occ_active_abort_cnt": jnp.zeros((), jnp.int32)}
         if cfg.net_delay_ticks > 0:
             # prepare-phase reservation (net_delay mode): a yes-voted
             # validator's writes block later validators until its delayed
@@ -243,6 +248,29 @@ class Occ(CCPlugin):
         valid0 = group_and(pass1) if group_and is not None else pass1
         valid, _ = jax.lax.while_loop(
             lambda c: c[1], step, (valid0, jnp.any(pass1) | True))
+        measuring = tick >= cfg.warmup_ticks
+        cnt = lambda m: jnp.where(measuring,
+                                  jnp.sum(m.astype(jnp.int32)), 0)
+        # outcome counters bump once per VALIDATION EVENT (like the
+        # reference's per-validate() increments — a deferred commit's
+        # re-validation counts again there too).  Sharded (grouped) path:
+        # one representative entry per (owner, home txn) group, so
+        # per-entry masks don't inflate by the accesses-per-node factor.
+        if group_and is not None:
+            rep = seg.unpermute(g_orig, gstarts) & finishing
+            hist_fail = rep & ~group_and(pass1)
+            active_fail = rep & group_and(pass1) & ~valid
+        else:
+            hist_fail = finishing & ~pass1
+            active_fail = pass1 & ~valid
+        db = {**db,
+              # hist-abort: the validation failed the committed-history /
+              # prepare-mark checks; active-abort: passed them but lost
+              # to an earlier valid same-round validator
+              "occ_hist_abort_cnt": db["occ_hist_abort_cnt"]
+              + cnt(hist_fail),
+              "occ_active_abort_cnt": db["occ_active_abort_cnt"]
+              + cnt(active_fail)}
         if "occ_prep" in db:
             # stamp prepare marks on the yes-voted write set (exclusive by
             # construction: foreign-marked rows failed pconf above and two

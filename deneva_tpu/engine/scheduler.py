@@ -709,6 +709,11 @@ class Engine:
         (statistics/stats.cpp:1541-1575)."""
         s = {k: np.asarray(v).item() for k, v in state.stats.items()
              if not k.startswith("arr_") and k != "wr_ring_cursor"}
+        # CC-plugin counters (maat_case*, occ_*_abort, mvcc_tail_fold —
+        # the reference's per-algorithm stats.h families) live in db as
+        # 0-d scalars ending in _cnt
+        s.update({k: int(np.asarray(v)) for k, v in state.db.items()
+                  if k.endswith("_cnt") and np.asarray(v).ndim == 0})
         commits = max(s["txn_cnt"], 1)
         out = dict(s)
         out["tput_per_tick"] = s["txn_cnt"] / max(s["measured_ticks"], 1)

@@ -120,6 +120,13 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
     node_stride = n_nodes
     if workload is None:
         workload = wl_registry.get(cfg)
+    # debug mode ladder (config.h:314-319), same semantics as the
+    # single-shard tick: NOCC grants every access at the owner
+    # (row.cpp:199-206), QRY_ONLY additionally applies no writes,
+    # SIMPLE commits at admission — per-node bottleneck isolation
+    from deneva_tpu.config import MODE_NOCC, MODE_NORMAL, MODE_SIMPLE
+    normal = cfg.mode == MODE_NORMAL
+    apply_writes = cfg.mode in (MODE_NORMAL, MODE_NOCC)
 
     def tick_fn(state: ShardState, node_id) -> ShardState:
         txn, db, data, stats = state.txn, state.db, state.data, state.stats
@@ -160,7 +167,8 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
         ts_counter = state.ts_counter + jnp.sum(need_ts.astype(jnp.int32))
 
         status = jnp.where(free, STATUS_RUNNING, status)
-        cursor = jnp.where(free, 0, txn.cursor)
+        cursor = jnp.where(free, n_req if cfg.mode == MODE_SIMPLE else 0,
+                           txn.cursor)
         restarts = jnp.where(free, 0, txn.restarts)
         start_tick = jnp.where(free, t, start_tick)
         first_start_tick = jnp.where(free, t, txn.first_start_tick)
@@ -177,7 +185,8 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
                        start_tick=start_tick, first_start_tick=first_start_tick,
                        keys=keys, is_write=is_write, n_req=n_req,
                        txn_type=txn_type, targs=targs, aux=aux)
-        db = plugin.on_start(cfg, db, txn, free | expire)
+        if normal:
+            db = plugin.on_start(cfg, db, txn, free | expire)
 
         # ---- network-delay latches: reset on a fresh attempt ----
         dly = cfg.net_delay_ticks
@@ -339,8 +348,17 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
             vdb[f] = owner_cat(recv[f], fields[f])
 
         vactive = o_live
-        dec, vdb = plugin.access(cfg, vdb, vtxn, vactive)
-        votes, vdb = plugin.validate(cfg, vdb, vtxn, o_fin, t)
+        if normal:
+            dec, vdb = plugin.access(cfg, vdb, vtxn, vactive)
+            votes, vdb = plugin.validate(cfg, vdb, vtxn, o_fin, t)
+        else:
+            # NOCC ladder: every request grants at its owner, every vote
+            # is yes (row.cpp:199-206)
+            from deneva_tpu.cc.base import AccessDecision
+            o_req = (((o_flags >> 2) & 1) == 1) & o_live
+            z = jnp.zeros((Bv, 1), dtype=bool)
+            dec = AccessDecision(grant=o_req[:, None], wait=z, abort=z)
+            votes = o_fin
         if dly and plugin.release_on_vabort:
             # refresh prepare marks of yes-voted txns still awaiting their
             # delayed/deferred commit, so expiry only ever reaps marks
@@ -584,8 +602,9 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
         vdbB = dict(db)
         if plugin.commit_ts_field:
             vdbB[plugin.commit_ts_field] = rB_cts
-        vdbB = plugin.on_commit(cfg, vdbB, vtxnB, rB_commit,
-                                commit_ts=rB_cts, tick=t)
+        if normal:
+            vdbB = plugin.on_commit(cfg, vdbB, vtxnB, rB_commit,
+                                    commit_ts=rB_cts, tick=t)
         if dly and plugin.release_on_vabort:
             ffin_loc = fflag_flat[:nE] & local_e
             fmask = jnp.concatenate(
@@ -597,9 +616,10 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
         db = {**db, **{k: v for k, v in vdbB.items()
                        if k not in plugin.txn_db_fields
                        and k != plugin.commit_ts_field}}
-        data = data.at[jnp.where(rB_commit & rB_iw, rB_key,
-                                 NULL_KEY)].add(1, mode="drop")
-        if workload.has_effects:
+        if apply_writes:
+            data = data.at[jnp.where(rB_commit & rB_iw, rB_key,
+                                     NULL_KEY)].add(1, mode="drop")
+        if workload.has_effects and apply_writes:
             tables = workload.apply_commit_entries(
                 cfg, tables, rB_key, node_id,
                 {f: owner_cat(recvB[f], flds[f].reshape(-1))
@@ -681,7 +701,7 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
         restarts2 = jnp.where(abort_now, txn.restarts + 1, txn.restarts)
         txn = txn._replace(status=status, cursor=cursor,
                            backoff_until=backoff_until, restarts=restarts2)
-        db = plugin.on_abort(cfg, db, txn, abort_now | ua)
+        db = plugin.on_abort(cfg, db, txn, abort_now | ua) if normal else db
         if dly:
             done = commit | ua | abort_now
             net["grant_tick"] = jnp.where(done[:, None], BIG_TS,
@@ -753,8 +773,6 @@ class ShardedEngine:
                  devices=None):
         assert cfg.node_cnt >= 1
         assert cfg.part_cnt == cfg.node_cnt, "part striping == node striping"
-        assert cfg.mode == "NORMAL", \
-            "the MODE debug ladder is a single-shard isolation tool"
         self.cfg = cfg
         self.plugin = cc_registry.get(cfg.cc_alg)
         self.workload = wl_registry.get(cfg)
@@ -910,6 +928,10 @@ class ShardedEngine:
              if not k.startswith("arr_")}
         s = {k: int(v) if k in STAT_KEYS_I32 + SHARD_STAT_KEYS
              + ("lat_ring_cursor",) else v for k, v in s.items()}
+        # CC-plugin counters (db 0-d-per-node scalars ending _cnt),
+        # summed across nodes like the per-thread stats merge
+        s.update({k: int(np.asarray(v).sum()) for k, v in state.db.items()
+                  if k.endswith("_cnt") and np.asarray(v).ndim <= 1})
         commits = max(s["txn_cnt"], 1)
         out = dict(s)
         out["measured_ticks"] = int(np.asarray(state.stats["measured_ticks"]

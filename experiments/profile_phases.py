@@ -1,0 +1,82 @@
+"""Per-phase cost of the sharded tick, by end-to-end ablation.
+
+The reference accumulates per-queue mutex and processing timers on the
+host (message queue, work queue — statistics/stats.h time families).  In
+the rebuild one tick is ONE fused XLA program, so phase wall-times cannot
+be read from inside a run; instead this harness ablates whole-tick
+configurations whose deltas attribute cost to phases:
+
+  local-only tick   (mpr=0):    admission + local arbitration + commit
+  mixed tick        (mpr=1):    + pack + 3 all_to_all exchanges + unpack
+  NOCC mixed tick:              mixed minus the CC arbitration kernel
+
+so  exchange+routing ~= mixed - local,  arbitration ~= mixed - NOCC.
+End-to-end ablation is the only honest attribution: isolated micro-
+kernels get dead-code-eliminated or lose their fusion context (the
+PROFILE.md cost model was measured the same way).  The per-run [summary]
+line carries phase WORK counters instead (remote_entry_cnt,
+commit_defer_cnt, lat_network_time).
+
+Usage: python experiments/profile_phases.py [n_nodes] [batch]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+import numpy as np
+
+jax.config.update("jax_platforms", "cpu")
+
+from deneva_tpu.config import Config
+from deneva_tpu.parallel.sharded import ShardedEngine
+
+ITERS = 30
+
+
+def time_tick(cfg) -> float:
+    eng = ShardedEngine(cfg)
+    eng._build()
+    st = eng.init_state()
+    for _ in range(3):                      # compile + warm
+        st = eng._jit_tick(st)
+    jax.block_until_ready(st.tick)
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(ITERS):
+            st = eng._jit_tick(st)
+        jax.block_until_ready(st.tick)
+        ts.append((time.perf_counter() - t0) / ITERS * 1e3)
+    return float(np.median(ts))
+
+
+def main(n_nodes: int = 4, B: int = 256):
+    base = dict(cc_alg="NO_WAIT", node_cnt=n_nodes, part_cnt=n_nodes,
+                batch_size=B, synth_table_size=1 << 14, req_per_query=6,
+                query_pool_size=1 << 12)
+    t_local = time_tick(Config(mpr=0.0, part_per_txn=1, **base))
+    t_mixed = time_tick(Config(mpr=1.0, part_per_txn=2, **base))
+    t_nocc = time_tick(Config(mpr=1.0, part_per_txn=2, mode="NOCC",
+                              **base))
+
+    print(f"# sharded phase costs by ablation, {n_nodes} nodes, B={B} "
+          f"(virtual CPU mesh; shapes-only, the real fabric is ICI)")
+    print(f"local-only tick (no remote routing): {t_local:.3f} ms")
+    print(f"mixed tick (pack + 3 exchanges):     {t_mixed:.3f} ms")
+    print(f"NOCC mixed tick (no arbitration):    {t_nocc:.3f} ms")
+    print(f"-> routing + exchange share: {t_mixed - t_local:+.3f} ms")
+    print(f"-> CC arbitration share:     {t_mixed - t_nocc:+.3f} ms")
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 4,
+         int(sys.argv[2]) if len(sys.argv) > 2 else 256)

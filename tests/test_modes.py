@@ -132,3 +132,30 @@ def test_invariant_kernel_clean_sharded():
     s = eng.summary(st)
     assert s["invariant_violation_cnt"] == 0
     assert s["txn_cnt"] > 0
+
+
+def test_mode_ladder_sharded():
+    """The NOCC/QRY_ONLY/SIMPLE ladder now runs through the sharded
+    engine (per-node bottleneck isolation, the round-3 gap): each
+    stripped layer can only help commits, and QRY_ONLY applies no
+    writes."""
+    import numpy as np
+    from deneva_tpu.parallel.sharded import ShardedEngine
+    from deneva_tpu.config import Config
+
+    def run(mode):
+        cfg = Config(cc_alg="NO_WAIT", node_cnt=4, part_cnt=4,
+                     batch_size=32, synth_table_size=1 << 12,
+                     req_per_query=4, zipf_theta=0.8,
+                     query_pool_size=1 << 10, mpr=1.0, part_per_txn=2,
+                     mode=mode)
+        eng = ShardedEngine(cfg)
+        st = eng.run(30)
+        return eng.summary(st), eng.global_data_sum(st)
+
+    (s_n, d_n), (s_c, d_c), (s_q, d_q), (s_s, d_s) = (
+        run("NORMAL"), run("NOCC"), run("QRY_ONLY"), run("SIMPLE"))
+    assert s_n["txn_cnt"] <= s_c["txn_cnt"] <= s_s["txn_cnt"]
+    assert s_c["total_txn_abort_cnt"] == 0
+    assert d_n == s_n["write_cnt"] and d_c == s_c["write_cnt"]
+    assert d_q == 0 and d_s == 0        # no writes applied

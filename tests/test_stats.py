@@ -95,3 +95,44 @@ def test_prog_line_tag():
     line = eng.summary_line(st, prog=True)
     assert line.startswith("[prog] ")
     assert stats_mod.parse_summary(line) == {}   # parser only takes summary
+
+
+def test_cc_case_counter_families():
+    """The per-algorithm stats.h families (maat_case1-6, occ check aborts)
+    ride the [summary] line and round-trip through the parser port."""
+    eng, st = run_engine(cc_alg="MAAT")
+    line = eng.summary_line(st, wall_seconds=1.0)
+    parsed = stats_mod.parse_summary(line)
+    for k in ("maat_case1", "maat_case2", "maat_case3", "maat_case4",
+              "maat_case6"):
+        assert k in parsed, k
+    # contention at zipf 0.8 must actually exercise the case machinery
+    assert parsed["maat_case1"] > 0
+    assert parsed["maat_case6"] >= 0
+
+    eng, st = run_engine(cc_alg="OCC")
+    parsed = stats_mod.parse_summary(eng.summary_line(st, wall_seconds=1.0))
+    assert "occ_hist_abort" in parsed and "occ_active_abort" in parsed
+    s = eng.summary(st)
+    # every validation abort is classified into exactly one family
+    assert parsed["occ_hist_abort"] + parsed["occ_active_abort"] \
+        == s["vabort_cnt"]
+
+
+def test_cc_counters_sharded_sum_across_nodes():
+    from deneva_tpu.parallel.sharded import ShardedEngine
+    kw = dict(node_cnt=4, part_cnt=4, batch_size=32,
+              synth_table_size=1 << 12, req_per_query=4, zipf_theta=0.7,
+              query_pool_size=1 << 10, mpr=1.0, part_per_txn=2)
+    eng = ShardedEngine(Config(cc_alg="MAAT", **kw))
+    st = eng.run(30)
+    s = eng.summary(st)
+    assert s["maat_case1_cnt"] > 0
+    # per-owner validation events: a txn finishing on k owners counts one
+    # event per owner, so events bound home-side aborts from above but
+    # cannot exceed events-per-owner x validations
+    eng = ShardedEngine(Config(cc_alg="OCC", **kw))
+    st = eng.run(30)
+    s = eng.summary(st)
+    assert s["occ_hist_abort_cnt"] + s["occ_active_abort_cnt"] \
+        >= s["vabort_cnt"]
