@@ -190,6 +190,20 @@ class Config:
     repl_cnt: int = 0            # 0 or 1: replicate the command log to the
                                  # next shard (LOG_MSG / LOG_MSG_RSP analog;
                                  # sharded engine only)
+    #: replication topology (config.h:24-27, ISREPLICA global.h:301):
+    #: "aa" — active-active: every shard is a worker and replicates its
+    #:   log on its ring successor (the round-3 behavior);
+    #: "ap" — active-passive: the mesh's upper half are DEDICATED replica
+    #:   nodes (no transactions, no row ownership; part_cnt ==
+    #:   node_cnt/2).  Worker i streams its log records to replica
+    #:   part_cnt+i each tick and a txn may only commit once the
+    #:   replica's acked LSN covers every record logged before its
+    #:   finish (group-commit semantics); the ack returns through a
+    #:   repl_lag_ticks-deep delay ring, so replica lag visibly stalls
+    #:   commits (LOG_MSG -> LOG_MSG_RSP blocking,
+    #:   worker_thread.cpp:535-554).
+    repl_mode: str = "aa"
+    repl_lag_ticks: int = 1      # ack transit/flush lag at the replica
     log_buf_cap: int = 1 << 16   # command-log ring slots per shard
 
     # --- Calvin (reference config.h:348 SEQ_BATCH_TIMER) ---
@@ -246,12 +260,22 @@ class Config:
             assert self.cc_alg in (NO_WAIT, WAIT_DIE, TIMESTAMP), \
                 "sub_ticks refines NO_WAIT/WAIT_DIE/TIMESTAMP arbitration"
             assert self.acquire_window == 1, "sub_ticks needs window=1"
+        assert self.repl_mode in ("aa", "ap")
+        if self.repl_mode == "ap":
+            assert self.logging and self.repl_cnt > 0, \
+                "AP replication replicates the command log"
+            assert self.node_cnt >= 2 and self.node_cnt % 2 == 0, \
+                "AP needs worker/replica mesh halves"
+            assert self.part_cnt == self.node_cnt // 2, \
+                "AP: partitions live on the worker half only"
         if self.net_delay_ticks > 0:
             # delay models message transit between shards; a single node
             # has no remote accesses for it to act on
             assert self.node_cnt > 1, \
                 "net_delay_ticks needs a multi-node topology"
-        assert self.part_cnt >= self.node_cnt and self.part_cnt % self.node_cnt == 0
+        if self.repl_mode != "ap":
+            assert self.part_cnt >= self.node_cnt \
+                and self.part_cnt % self.node_cnt == 0
         assert self.synth_table_size % self.part_cnt == 0
         # row ids must fit 30 bits: lock arbitration packs (row_id, kind)
         # into one int32 sort key (deneva_tpu/cc/twopl.py)

@@ -116,6 +116,15 @@ def _zeros_stats(cfg: Config | None = None,
             # (process_log_msg, worker_thread.cpp:527-533)
             s["arr_repl_key"] = jnp.zeros(cfg.log_buf_cap, jnp.int32)
             s["repl_lsn"] = jnp.zeros((), jnp.int32)
+            if cfg.repl_mode == "ap":
+                # active-passive: per-txn commit-gate LSN stamps, the
+                # replica-ack lag ring, and the acked high-water mark
+                # (LOG_MSG_RSP blocking, worker_thread.cpp:535-554)
+                s["arr_need_lsn"] = jnp.zeros(cfg.batch_size, jnp.int32)
+                if cfg.repl_lag_ticks > 0:
+                    s["arr_repl_ackring"] = jnp.zeros(
+                        cfg.repl_lag_ticks, jnp.int32)
+                s["repl_acked_lsn"] = jnp.zeros((), jnp.int32)
     return s
 
 

@@ -118,6 +118,7 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
     Q = pool_dev["kw"].shape[0]
     R = pool_dev["kw"].shape[1]
     node_stride = n_nodes
+    n_parts = cfg.part_cnt          # == n_nodes, or n_nodes//2 in AP mode
     if workload is None:
         workload = wl_registry.get(cfg)
     # debug mode ladder (config.h:314-319), same semantics as the
@@ -151,6 +152,9 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
             gate = gate + jnp.sum(expire.astype(jnp.int32))
         acap = min(acap, cfg.batch_size, Q)
         free = free & (gate < acap)
+        if cfg.repl_mode == "ap":
+            # ISREPLICA (global.h:301): the upper mesh half runs no txns
+            free = free & (node_id < n_parts)
         n_free = jnp.sum(free.astype(jnp.int32))
 
         from deneva_tpu.engine.scheduler import pool_admit
@@ -204,7 +208,7 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
             # later, sequencer.cpp:283-326 — deterministic interleaving
             # needs the COMPLETE epoch, so local entries wait too);
             # otherwise only remote-owned rows pay
-            rem_e = (txn.keys % n_nodes) != node_id
+            rem_e = (txn.keys % n_parts) != node_id
             delay_e = (jnp.full((B, R), dly, jnp.int32)
                        if plugin.never_aborts
                        else jnp.where(rem_e, dly, 0))
@@ -221,6 +225,12 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
             # commit blocks on the LOG_FLUSHED (+ replica ack) round trip
             # (worker_thread.cpp:535-554); stamped at last-grant below
             finishing = finishing & (txn.backoff_until <= t)
+            if cfg.repl_cnt > 0 and cfg.repl_mode == "ap":
+                # AP: additionally wait until the paired replica has acked
+                # every record logged before this txn finished executing
+                # (group-commit semantics; replica lag stalls commits)
+                finishing = finishing & (stats["repl_acked_lsn"]
+                                         >= stats["arr_need_lsn"])
         # workload rollback (TPC-C rbk): frees the slot, no effects, no votes
         ua = workload.user_abort(cfg, txn, finishing)
         finishing = finishing & ~ua
@@ -270,9 +280,9 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
         # node's own local entries side by side, so exchange capacity is
         # sized for remote traffic only (an all-local workload previously
         # funneled all B*R entries through the self-lane and overflowed).
-        local_e = live_e & (key_g % n_nodes == node_id)
-        dest = jnp.where(live_e & ~local_e, key_g % n_nodes, n_nodes)
-        key_l = key_g // n_nodes
+        local_e = live_e & (key_g % n_parts == node_id)
+        dest = jnp.where(live_e & ~local_e, key_g % n_parts, n_nodes)
+        key_l = key_g // n_parts
         ts_e = ent.ts
         stick = jnp.broadcast_to(txn.start_tick[:, None], (B, R))
         if plugin.ship_access_tick:
@@ -634,26 +644,52 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
                                      (B, R)).reshape(-1)
             stats = append_log_ring(stats, cfg, wflat, key_g, tid_e)
             if cfg.repl_cnt > 0:
-                # ship this tick's records to the successor shard (the
-                # LOG_MSG -> replica -> LOG_MSG_RSP path, worker_thread.cpp:
-                # 527-554, active-active layout: each shard replicates its
-                # log on its ring neighbor); the ack latency is inside
-                # log_flush_ticks
+                # ship this tick's records to the replica (LOG_MSG ->
+                # replica -> LOG_MSG_RSP, worker_thread.cpp:527-554).
+                # "aa": each shard replicates on its ring successor, ack
+                # latency inside log_flush_ticks.  "ap": worker i streams
+                # to DEDICATED replica n_parts+i, whose received-LSN
+                # high-water mark returns through a repl_lag_ticks delay
+                # ring and gates commits (above).
                 recs = jnp.where(wflat, key_g, NULL_KEY)
-                perm = [(i, (i + 1) % n_nodes) for i in range(n_nodes)]
-                rrecs = jax.lax.ppermute(recs, AXIS, perm)
-                rlive = rrecs != NULL_KEY
+                if cfg.repl_mode == "ap":
+                    perm = [(i, n_parts + i) for i in range(n_parts)]
+                    rrecs = jax.lax.ppermute(recs, AXIS, perm)
+                    # ppermute zero-fills non-receivers: ship the live
+                    # mask alongside (key 0 is a valid key)
+                    rlive = jax.lax.ppermute(
+                        wflat.astype(jnp.int32), AXIS, perm) == 1
+                else:
+                    perm = [(i, (i + 1) % n_nodes) for i in range(n_nodes)]
+                    rrecs = jax.lax.ppermute(recs, AXIS, perm)
+                    rlive = rrecs != NULL_KEY
                 rrank = jnp.cumsum(rlive.astype(jnp.int32)) - rlive.astype(
                     jnp.int32)
                 rpos2 = jnp.where(rlive,
                                   (stats["repl_lsn"] + rrank)
                                   % cfg.log_buf_cap,
                                   cfg.log_buf_cap)
+                repl_lsn2 = stats["repl_lsn"] \
+                    + jnp.sum(rlive.astype(jnp.int32))
                 stats = {**stats,
                          "arr_repl_key": stats["arr_repl_key"].at[
                              rpos2].set(rrecs, mode="drop"),
-                         "repl_lsn": stats["repl_lsn"]
-                         + jnp.sum(rlive.astype(jnp.int32))}
+                         "repl_lsn": repl_lsn2}
+                if cfg.repl_mode == "ap":
+                    # the replica acks its new high-water mark; the worker
+                    # sees it repl_lag_ticks later
+                    ack = jax.lax.ppermute(
+                        repl_lsn2, AXIS,
+                        [(n_parts + i, i) for i in range(n_parts)])
+                    if cfg.repl_lag_ticks > 0:
+                        ring = stats["arr_repl_ackring"]
+                        idx = t % cfg.repl_lag_ticks
+                        acked = ring[idx]
+                        stats = {**stats,
+                                 "arr_repl_ackring": ring.at[idx].set(ack),
+                                 "repl_acked_lsn": acked}
+                    else:
+                        stats = {**stats, "repl_acked_lsn": ack}
 
         # ---- 6. commit/abort bookkeeping (home) ----
         n_commit = jnp.sum(commit.astype(jnp.int32))
@@ -664,7 +700,7 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
         stats = bump(stats, "vabort_cnt",
                      jnp.sum(vabort.astype(jnp.int32)), measuring)
 
-        stats = track_parts_touched(stats, txn, commit, n_nodes, measuring)
+        stats = track_parts_touched(stats, txn, commit, n_parts, measuring)
         stats = record_commit_latency(stats, commit, t, txn.start_tick,
                                       measuring)
         stats = bump(stats, "unique_txn_abort_cnt",
@@ -697,6 +733,9 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
             backoff_base = jnp.where(reached,
                                      t + 1 + cfg.log_flush_ticks,
                                      backoff_base)
+            if cfg.repl_cnt > 0 and cfg.repl_mode == "ap":
+                stats = {**stats, "arr_need_lsn": jnp.where(
+                    reached, stats["log_lsn"], stats["arr_need_lsn"])}
         backoff_until = jnp.where(abort_now, t + penalty, backoff_base)
         restarts2 = jnp.where(abort_now, txn.restarts + 1, txn.restarts)
         txn = txn._replace(status=status, cursor=cursor,
@@ -772,7 +811,13 @@ class ShardedEngine:
     def __init__(self, cfg: Config, pool: QueryPool | None = None,
                  devices=None):
         assert cfg.node_cnt >= 1
-        assert cfg.part_cnt == cfg.node_cnt, "part striping == node striping"
+        if cfg.repl_mode == "ap":
+            # active-passive: partitions stripe over the worker half only;
+            # nodes [part_cnt, node_cnt) are dedicated replicas
+            assert cfg.part_cnt == cfg.node_cnt // 2
+        else:
+            assert cfg.part_cnt == cfg.node_cnt, \
+                "part striping == node striping"
         self.cfg = cfg
         self.plugin = cc_registry.get(cfg.cc_alg)
         self.workload = wl_registry.get(cfg)
@@ -794,9 +839,13 @@ class ShardedEngine:
         assert len(devices) == N, (len(devices), N)
         self.mesh = Mesh(np.array(devices), (AXIS,))
 
-        # per-node query streams: node p serves queries with home_part == p
-        Qn = pool.size // N
-        sel = lambda a: np.stack([a[p::N][:Qn] for p in range(N)])
+        # per-node query streams: worker p serves queries with
+        # home_part == p; AP replica nodes reuse stream 0 but never admit
+        W = cfg.part_cnt
+        Qn = pool.size // W
+        sel = lambda a: np.stack(
+            [a[min(p, W - 1) % W if p < W else 0::W][:Qn]
+             for p in range(N)])
         from deneva_tpu.engine.scheduler import _pool_to_device
         import dataclasses as _dc
         stacked = {f: sel(getattr(pool, f))
@@ -817,7 +866,8 @@ class ShardedEngine:
             for k in all_keys}
 
         B, R = cfg.batch_size, pool.max_req
-        self.cap = max(int(B * R / N * cfg.route_capacity_factor), R)
+        self.cap = max(int(B * R / cfg.part_cnt
+                           * cfg.route_capacity_factor), R)
         if self.plugin.never_aborts:
             # Calvin has no abort path, and a dropped HELD entry would be
             # invisible to the row owner — another writer could grant and
@@ -850,7 +900,7 @@ class ShardedEngine:
         cfg = self.cfg
         N = cfg.node_cnt
         B, R = cfg.batch_size, self.pool.max_req
-        rows_local = self.n_rows // N
+        rows_local = self.n_rows // cfg.part_cnt
 
         def one(part):
             db = self.plugin.init_db(cfg, rows_local, B, R)

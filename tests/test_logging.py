@@ -106,3 +106,46 @@ def test_sharded_log_replay_reconstructs_global_data():
     for p in range(4):
         glob[p::4] = d[p]
     assert (replayed == glob).all()
+
+
+class TestActivePassive:
+    """AP replication (config.h:24-27 REPLICA_CNT, ISREPLICA global.h:301):
+    dedicated replica nodes on the mesh's upper half receive the log
+    stream; commit blocks until the replica's acked LSN covers the txn's
+    records (LOG_MSG -> LOG_MSG_RSP, worker_thread.cpp:535-554)."""
+
+    def _run(self, lag, ticks=40):
+        from deneva_tpu.parallel.sharded import ShardedEngine
+        cfg = Config(cc_alg="NO_WAIT", node_cnt=4, part_cnt=2,
+                     batch_size=32, synth_table_size=1 << 12,
+                     req_per_query=4, zipf_theta=0.6,
+                     query_pool_size=1 << 10, mpr=1.0, part_per_txn=2,
+                     logging=True, repl_cnt=1, repl_mode="ap",
+                     repl_lag_ticks=lag)
+        eng = ShardedEngine(cfg)
+        st = eng.run(ticks)
+        return eng, st, eng.summary(st)
+
+    def test_replica_mirrors_worker_log_exactly(self):
+        eng, st, s = self._run(lag=1)
+        assert eng.global_data_sum(st) == s["write_cnt"]
+        lsn = np.asarray(st.stats["log_lsn"])
+        rlsn = np.asarray(st.stats["repl_lsn"])
+        # workers (nodes 0,1) log; replicas (nodes 2,3) mirror exactly
+        assert lsn[2] == lsn[3] == 0
+        assert rlsn[0] == rlsn[1] == 0
+        assert rlsn[2] == lsn[0] and rlsn[3] == lsn[1]
+        assert lsn[0] > 0
+        # and the replicated keys match the workers' log rings
+        n0 = int(lsn[0])
+        assert (np.asarray(st.stats["arr_log_key"][0][:n0])
+                == np.asarray(st.stats["arr_repl_key"][2][:n0])).all()
+
+    def test_commit_blocked_on_replica_ack_lag(self):
+        _, _, fast = self._run(lag=1)
+        _, _, slow = self._run(lag=8)
+        assert fast["txn_cnt"] > 0 and slow["txn_cnt"] > 0
+        # injected replica lag must stall commits and stretch latency
+        assert slow["txn_cnt"] < fast["txn_cnt"]
+        assert slow["avg_latency_ticks_short"] \
+            > fast["avg_latency_ticks_short"]
