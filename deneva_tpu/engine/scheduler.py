@@ -289,15 +289,18 @@ def trace_tick_events(stats: dict, t, n_admit, n_commit, n_abort,
 
 
 def recon_defer(stats: dict, workload, txn_type, free, status,
-                backoff_until, t, measuring):
+                backoff_until, t, measuring, defer_ticks: int = 1):
     """Calvin reconnaissance deferral (sequencer.cpp:88-114): recon-typed
-    admissions sleep one epoch.  Returns (status, backoff_until, stats)."""
+    admissions sleep one epoch (plus the message transit when a network
+    delay is modeled, so the recon pass's shadow read requests can reach
+    their owners before the real txn resumes).  Returns
+    (status, backoff_until, stats)."""
     is_recon = jnp.zeros_like(free)
     for tt in workload.recon_types:
         is_recon = is_recon | (txn_type == tt)
     is_recon = free & is_recon
     status = jnp.where(is_recon, STATUS_BACKOFF, status)
-    backoff_until = jnp.where(is_recon, t + 1, backoff_until)
+    backoff_until = jnp.where(is_recon, t + defer_ticks, backoff_until)
     stats = bump(stats, "recon_cnt",
                  jnp.sum(is_recon.astype(jnp.int32)), measuring)
     return status, backoff_until, stats
@@ -491,8 +494,19 @@ def make_tick(cfg: Config, plugin, pool_dev: dict, workload=None):
             active = ((txn.status == STATUS_RUNNING)
                       | (txn.status == STATUS_WAITING)) & ~vabort
             has_req = active & (txn.cursor < txn.n_req)
+            # Calvin recon lock traffic (sequencer.cpp:88-114): deferred
+            # recon txns request their footprint READ-ONLY this epoch;
+            # their decisions are discarded (has_req excludes BACKOFF)
+            acc_active = active
+            acc_txn = txn
+            if plugin.epoch_admission and workload.recon_types:
+                shadow = (txn.status == STATUS_BACKOFF) \
+                    & (txn.backoff_until > t)
+                acc_active = active | shadow
+                acc_txn = txn._replace(
+                    is_write=txn.is_write & ~shadow[:, None])
             if normal:
-                dec, db = plugin.access(cfg, db, txn, active)
+                dec, db = plugin.access(cfg, db, acc_txn, acc_active)
             else:
                 from deneva_tpu.cc.base import AccessDecision
                 reqm = (active[:, None] & (ridx >= txn.cursor[:, None])

@@ -180,9 +180,11 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
 
         backoff_until = txn.backoff_until
         if plugin.epoch_admission and workload.recon_types:
+            # defer one epoch + the request transit (net_delay mode), so
+            # the shadow read footprint reaches its owners before resume
             status, backoff_until, stats = recon_defer(
                 stats, workload, txn_type, free, status, backoff_until, t,
-                measuring)
+                measuring, defer_ticks=1 + cfg.net_delay_ticks)
 
         txn = TxnState(status=status, cursor=cursor, ts=ts, pool_idx=pool_idx,
                        restarts=restarts, backoff_until=backoff_until,
@@ -219,6 +221,17 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
         from deneva_tpu.config import READ_COMMITTED, READ_UNCOMMITTED
         from deneva_tpu.engine.state import make_entries
         active = (txn.status == STATUS_RUNNING) | (txn.status == STATUS_WAITING)
+        # Calvin reconnaissance lock traffic (sequencer.cpp:88-114): a
+        # recon-deferred txn ships its FULL footprint as READ requests
+        # during its deferral epoch — the transient read locks the
+        # reference's recon pass takes and releases.  Decisions for these
+        # entries are discarded (the txn is in BACKOFF; it resumes as the
+        # real txn next epoch), but their FIFO queue presence delays
+        # conflicting writers exactly one epoch.
+        recon_shadow = jnp.zeros_like(active)
+        if plugin.epoch_admission and workload.recon_types:
+            recon_shadow = (txn.status == STATUS_BACKOFF) \
+                & (txn.backoff_until > t)
         ridx = jnp.broadcast_to(jnp.arange(R, dtype=jnp.int32), (B, R))
         finishing = (txn.status == STATUS_RUNNING) & (txn.cursor >= txn.n_req)
         if cfg.logging:
@@ -235,7 +248,8 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
         ua = workload.user_abort(cfg, txn, finishing)
         finishing = finishing & ~ua
         ent = make_entries(
-            txn, active,
+            txn._replace(is_write=txn.is_write & ~recon_shadow[:, None]),
+            active | recon_shadow,
             read_locks_held=(plugin.request_all
                              or cfg.isolation_level not in (READ_COMMITTED,
                                                             READ_UNCOMMITTED)),
@@ -844,8 +858,7 @@ class ShardedEngine:
         W = cfg.part_cnt
         Qn = pool.size // W
         sel = lambda a: np.stack(
-            [a[min(p, W - 1) % W if p < W else 0::W][:Qn]
-             for p in range(N)])
+            [a[(p if p < W else 0)::W][:Qn] for p in range(N)])
         from deneva_tpu.engine.scheduler import _pool_to_device
         import dataclasses as _dc
         stacked = {f: sel(getattr(pool, f))

@@ -36,10 +36,16 @@ Documented divergences:
 - The Calvin reconnaissance pass (sequencer.cpp:88-114): the reference
   runs GETPARTBY*/ORDERPRODUCT once as a read-only recon txn to discover
   part_keys, then re-submits with the known set.  Here the pool already
-  knows the footprint, so recon is modeled as its observable cost: under
-  CALVIN these types are admitted one tick late (one epoch of recon
-  latency, counted in pps_recon_cnt via user-visible latency); the recon
-  pass's transient read locks are not replayed.
+  knows the footprint, so recon is modeled as its observable costs: under
+  CALVIN these types are admitted one epoch late (recon latency, counted
+  in recon_cnt), AND during the deferral epoch the txn ships its full
+  footprint as READ requests — the recon pass's transient read locks
+  occupy FIFO queue positions and delay conflicting writers exactly as
+  the reference's recon txn does (engines' recon-shadow entries).  The
+  one remaining unmodeled piece is stale-footprint re-walks: the
+  reference's re-submitted txn can discover a part set that changed
+  between recon and execution and abort on mismatch; the pool's
+  footprints are always current.
 """
 
 from __future__ import annotations

@@ -123,6 +123,27 @@ def main():
         print(f"tpcc {alg} mean={float(np.mean(ds)):+.4f}")
     lines.append("")
 
+    # --- PPS parity: chain-walk pools through the same oracle ---
+    lines += ["## PPS (8-type mix, 256-key tables, chain walks)", "",
+              "| CC_ALG | mean divergence | std |", "|---|---|---|"]
+    pps_kw = dict(workload="PPS", batch_size=64, query_pool_size=1 << 10,
+                  warmup_ticks=0, synth_table_size=8, max_part_key=256,
+                  max_product_key=256, max_supplier_key=256)
+    for alg in ("NO_WAIT", "WAIT_DIE", "TIMESTAMP", "MVCC", "OCC", "MAAT"):
+        ds = []
+        for seed in (1, 2, 3):
+            cfg = Config(cc_alg=alg, seed=seed, **pps_kw)
+            r = run_pair(cfg, n_ticks)
+            ds.append(r["batched"]["abort_rate"]
+                      - r["sequential"]["abort_rate"])
+        lines.append(f"| {alg} | {float(np.mean(ds)):+.4f} "
+                     f"| {float(np.std(ds)):.4f} |")
+        print(f"pps {alg} mean={float(np.mean(ds)):+.4f}")
+    lines.append("(CALVIN+PPS is excluded: the oracle does not model the "
+                 "recon deferral — its lock traffic is engine-modeled and "
+                 "conservation-tested instead, tests/test_pps.py.)")
+    lines.append("")
+
     # multi-shard parity: ShardedEngine on the virtual mesh vs the N-node
     # sequential oracle (exercises routing, owner arbitration, 2PC votes)
     lines += ["## multi-shard (zipf 0.6, 50/50 rw, mpr=1, ppt=2)", "",
@@ -148,8 +169,19 @@ def main():
     lines += [
         "Enforced continuously by `tests/test_parity.py`.",
         "",
-        "### Divergence accounting (round 3)",
+        "### Divergence accounting (rounds 3-4)",
         "",
+        "- **Multi-shard (round 4)**: three systematic gaps were found and "
+        "closed — local entries funneling through the exchange self-lane "
+        "(overflow aborts at mpr<1), per-entry instead of per-node OCC "
+        "active sets, and the oracle releasing aborted txns' locks "
+        "mid-pass where the engine (and the reference's release messages) "
+        "release next tick.  With the oracle also drawing restart and "
+        "admission timestamps in one slot-order pass, multi-shard "
+        "divergence is now EXACT (0.0000) for "
+        "NO_WAIT/WAIT_DIE/TIMESTAMP/MVCC/OCC/CALVIN at 2-8 nodes; "
+        "net_delay_ticks cells replay near-exactly "
+        "(tests/test_netdelay.py).",
         "- **2PL (NO_WAIT / WAIT_DIE)**: the one-round tick's only bias is "
         "within-tick lock-release timing (an aborting txn's locks stay "
         "visible until tick end).  `Config.sub_ticks` refines the time "

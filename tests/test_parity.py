@@ -133,6 +133,28 @@ def test_tpcc_parity(alg):
     assert r["abort_rate_divergence"] <= 0.02, r
 
 
+PPS_THRESH = {
+    # measured 3-seed means: MVCC/OCC exact, TIMESTAMP 0.3%, WAIT_DIE
+    # 0.9%, NO_WAIT 1.6%, MAAT 1.5% (chain-walk read prefixes amplify
+    # within-tick ordering for the lock family); x~1.5-2 headroom
+    "NO_WAIT": 0.045, "WAIT_DIE": 0.03, "TIMESTAMP": 0.015,
+    "MVCC": 0.005, "OCC": 0.005, "MAAT": 0.06,
+}
+
+
+@pytest.mark.parametrize("alg", list(PPS_THRESH))
+def test_pps_parity(alg):
+    """PPS pools (8-type mix, USES/SUPPLIES chain walks) through the same
+    oracle — the workload's long read chains and PART_AMOUNT writes."""
+    cfg = Config(workload="PPS", cc_alg=alg, batch_size=64,
+                 query_pool_size=1 << 10, warmup_ticks=0,
+                 synth_table_size=8, max_part_key=256,
+                 max_product_key=256, max_supplier_key=256)
+    r = run_pair(cfg, 50)
+    assert r["batched_conserved"] and r["sequential_conserved"], r
+    assert r["abort_rate_divergence"] <= PPS_THRESH[alg], r
+
+
 SHARDED_THRESH = {
     # The N-node oracle replays the sharded tick protocol exactly
     # (access-before-commit phase order, next-tick release visibility,
