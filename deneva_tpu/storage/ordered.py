@@ -1,0 +1,86 @@
+"""Ordered-index capability — the TPU-native answer to index_btree.
+
+The reference's B+-tree (index/index_btree.cpp:88-168) exists to serve
+ordered lookups: find the leaf for a key, then walk next-pointers for a
+range.  A latch-coupled pointer tree has no sensible XLA translation, but
+its CAPABILITY does: an immutable sorted key column per shard with
+binary-search lookup (`jnp.searchsorted` lowers to a log-depth
+while-free gather tree) and range scans as bounded windows over the
+sorted order.  This is the classic read-optimized index trade the
+reference itself makes for its (static) loaded tables — neither engine
+mutates index topology mid-run (inserts go to append rings, like the
+reference's index_insert at load time).
+
+API (all batched over query lanes):
+
+  idx = OrderedIndex(keys)            # sorted unique int32 keys, 1 shard
+  idx.lookup(q)                       # exact-match row ids (-1 miss)
+  idx.range_start(lo)                 # first position with key >= lo
+  idx.range_window(lo, W)             # row ids of the W smallest keys
+                                      #   >= lo (NULL-padded past hi)
+  idx.range_count(lo, hi)             # |{k: lo <= k < hi}|
+
+Row ids are the positions the caller's row store used at load time (the
+reference's item pointers).  A range-scan txn footprint is then
+`range_window(lo, W)` — see tests/test_ordered_index.py for a range
+workload expressed against the engine's access-program format.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+NULL_ROW = jnp.int32(2**31 - 1)
+
+
+class OrderedIndex:
+    """Immutable sorted-key index over one shard's rows."""
+
+    def __init__(self, keys):
+        k = np.asarray(keys)
+        assert k.ndim == 1 and k.size > 0
+        assert (np.diff(k) > 0).all(), "keys must be sorted unique"
+        self.keys = jnp.asarray(k.astype(np.int32))
+        self.n = int(k.shape[0])
+
+    def lookup(self, q):
+        """Exact-match positions for query keys q (…,) — -1 on miss
+        (index_read, index_btree.cpp:88-117)."""
+        q = jnp.asarray(q, jnp.int32)
+        pos = jnp.searchsorted(self.keys, q).astype(jnp.int32)
+        pc = jnp.clip(pos, 0, self.n - 1)
+        hit = self.keys[pc] == q
+        return jnp.where(hit, pc, -1)
+
+    def range_start(self, lo):
+        """First sorted position with key >= lo (the leaf descent)."""
+        return jnp.searchsorted(self.keys,
+                                jnp.asarray(lo, jnp.int32)).astype(jnp.int32)
+
+    def range_window(self, lo, W: int, hi=None):
+        """Positions of the W smallest keys >= lo (the next-pointer walk,
+        index_btree.cpp:118-168, as one static-width window); entries past
+        hi (exclusive, optional) or past the key column pad to NULL_ROW.
+
+        lo may be a scalar or a (Q,) batch; result gains a leading Q axis.
+        """
+        lo = jnp.asarray(lo, jnp.int32)
+        start = jnp.searchsorted(self.keys, lo).astype(jnp.int32)
+        offs = jnp.arange(W, dtype=jnp.int32)
+        pos = start[..., None] + offs if start.ndim else start + offs
+        valid = pos < self.n
+        pc = jnp.clip(pos, 0, self.n - 1)
+        if hi is not None:
+            valid = valid & (self.keys[pc]
+                             < jnp.asarray(hi, jnp.int32)[..., None]
+                             if start.ndim else
+                             self.keys[pc] < jnp.asarray(hi, jnp.int32))
+        return jnp.where(valid, pos, NULL_ROW)
+
+    def range_count(self, lo, hi):
+        """|{key in [lo, hi)}| — pure binary-search arithmetic."""
+        lo = jnp.asarray(lo, jnp.int32)
+        hi = jnp.asarray(hi, jnp.int32)
+        return (jnp.searchsorted(self.keys, hi)
+                - jnp.searchsorted(self.keys, lo)).astype(jnp.int32)
