@@ -65,9 +65,14 @@ def plot_lines(ax, rows, xs_of, y_of):
 def main():
     os.makedirs(PLOTS_DIR, exist_ok=True)
 
+    n_of = lambda c: int(c["cell"].split("-n")[1])  # noqa: E731
     for grid, xlabel, xs_of in (
-            ("ycsb_scaling", "nodes", lambda c: int(c["cell"].split("-n")[1])),
-            ("tpcc_scaling", "nodes", lambda c: int(c["cell"].split("-n")[1]))):
+            ("ycsb_scaling", "nodes", n_of),
+            ("tpcc_scaling", "nodes", n_of),
+            ("tpcc_scaling2", "nodes", n_of),
+            ("pps_scaling", "nodes", n_of),
+            ("ycsb_partitions", "partitions per txn (D=1)",
+             lambda c: int(c["cell"].split("-ppt")[1]))):
         rows = load(grid)
         if not rows:
             continue
@@ -94,6 +99,23 @@ def main():
         ax2.set_ylim(-0.02, 1.0)
         fig.tight_layout()
         fig.savefig(os.path.join(PLOTS_DIR, "ycsb_skew.png"))
+        plt.close(fig)
+
+    rows = load("ycsb_network")
+    if rows:
+        d_of = lambda c: int(c["cell"].split("-d")[1])  # noqa: E731
+        fig, (ax1, ax2) = plt.subplots(1, 2, figsize=(9.6, 3.4), dpi=150)
+        plot_lines(ax1, rows, d_of, lambda c: c["row"]["tput_per_tick"])
+        style(ax1, "one-way message delay D (ticks)", "commits per tick",
+              "ycsb_network: the distributed tax (4 nodes)")
+        ax1.legend(fontsize=7, frameon=False, ncol=2, labelcolor=INK)
+        plot_lines(ax2, rows, d_of,
+                   lambda c: c["row"]["avg_latency_ticks_short"])
+        style(ax2, "one-way message delay D (ticks)",
+              "commit latency (ticks)",
+              "ycsb_network: latency vs delay")
+        fig.tight_layout()
+        fig.savefig(os.path.join(PLOTS_DIR, "ycsb_network.png"))
         plt.close(fig)
 
     print(f"wrote plots to {PLOTS_DIR}")
