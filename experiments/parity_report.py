@@ -169,6 +169,11 @@ def main():
 
     # --- network-delay parity: the delayed tick protocol, engine vs the
     # oracle's _tick_delay replay (msg_queue.cpp:81-124 analog) ---
+    # NOTE: by this point the process has compiled ~100 XLA programs and
+    # LLVM can hit "Cannot allocate memory" on constrained hosts; if this
+    # section dies, regenerate it standalone in a fresh process and
+    # append before the "Enforced continuously" line (round-4 ran it
+    # that way).
     lines += ["## multi-shard with message delay (D=1, 2 nodes, mpr=1, "
               "ppt=2)", "",
               "| CC_ALG | divergence | tput ratio | conserved |",
