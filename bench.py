@@ -4,13 +4,15 @@ Mirrors the reference's metric of record — committed txns / measured second
 (``tput=`` in statistics/stats.cpp:437-447) — on the BASELINE.json config 2
 shape: YCSB, zipf 0.6 contention, 50/50 read-write, 16M rows, 10 req/txn.
 
-Two cells are measured (PROFILE.md has the cost model and tuning):
-- **faithful**: acquire_window=1, the reference's sequential state machine
-  (one access arbitrated per txn per tick) — the reference-comparable
-  number and the headline ``value``;
-- **greedy**: acquire_window=10 batch acquisition — the engine's native
-  batched operating point (abort-rate-shifting vs the reference;
-  Config.acquire_window docstring).
+The headline ``value`` is the NO_WAIT faithful cell (acquire_window=1, the
+reference's sequential state machine; PROFILE.md has the cost model and
+tuning).  ``greedy_tput`` is window-10 batch acquisition — the engine's
+native batched operating point.  ``algs`` carries EVERY CC algorithm's
+faithful cell plus a TPC-C cell (round-5 contract: the sort-bound
+algorithms MAAT/MVCC and TPC-C get a driver-visible, regression-guarded
+number), each with BOTH wall tput and commits/tick — the latter is immune
+to the tunneled chip's +-10-30% session drift, so cross-round comparisons
+should prefer it.
 
 Prints ONE JSON line {"metric", "value", "unit", "vs_baseline", ...}.
 vs_baseline scales the faithful number against the north star's per-chip
@@ -30,22 +32,31 @@ from deneva_tpu.engine.scheduler import Engine
 NORTH_STAR_CLUSTER = 1_000_000   # committed txns/s on a v5e-8 (BASELINE.md)
 NORTH_STAR_CHIPS = 8
 
+YCSB_KW = dict(
+    batch_size=8192,
+    synth_table_size=1 << 24,   # 16M rows (paper-scale, BASELINE.md grid)
+    req_per_query=10,
+    zipf_theta=0.6,
+    tup_read_perc=0.5,
+    query_pool_size=1 << 16,
+    warmup_ticks=0,
+    backoff=True,
+    # tuned concurrency throttle for BOTH cells: in the greedy cell it
+    # holds steady-state in-flight txns low enough that the abort rate
+    # stays ~0.16 (uncapped admission drives contention up and measures
+    # ~280k/s vs ~430k/s capped; sweep in PROFILE.md)
+    admit_cap=1024,
+)
 
-def run_cell(acquire_window: int, batch_size: int, admit_cap: int,
-             n_ticks: int = 300, with_summary: bool = False):
-    cfg = Config(
-        cc_alg="NO_WAIT",
-        batch_size=batch_size,
-        synth_table_size=1 << 24,   # 16M rows (paper-scale, BASELINE.md grid)
-        req_per_query=10,
-        zipf_theta=0.6,
-        tup_read_perc=0.5,
-        query_pool_size=1 << 16,
-        warmup_ticks=0,
-        backoff=True,
-        acquire_window=acquire_window,
-        admit_cap=admit_cap,
-    )
+# the PROFILE.md TPC-C cell: 64 warehouses, Payment/NewOrder mix, MVCC
+TPCC_KW = dict(
+    workload="TPCC", cc_alg="MVCC", batch_size=8192, num_wh=64,
+    cust_per_dist=2000, max_items=1024, query_pool_size=1 << 16,
+    warmup_ticks=0, admit_cap=1024,
+)
+
+
+def run_cell(cfg: Config, n_ticks: int = 300, windows: int = 7):
     eng = Engine(cfg)
     # two warmup rounds: the first post-compile dispatch runs ~5x slow
     # (device power/prefetch state), and the second reaches steady-state
@@ -55,11 +66,11 @@ def run_cell(acquire_window: int, batch_size: int, admit_cap: int,
     state = eng.run_compiled(n_ticks, state)
     jax.block_until_ready(state.stats["txn_cnt"])
 
-    # median of 7 measured windows: the tunneled chip shows ~+-8-15%
-    # window-to-window variance under host load, and each 300-tick window
-    # costs well under a second — more windows is the cheap stabilizer
-    tputs = []
-    for _ in range(7):
+    # median of `windows` measured windows: the tunneled chip shows
+    # ~+-8-15% window-to-window variance under host load, and each
+    # 300-tick window costs well under a second
+    tputs, cpt = [], []
+    for _ in range(windows):
         committed_before = int(np.asarray(state.stats["txn_cnt"]))
         t0 = time.perf_counter()
         state = eng.run_compiled(n_ticks, state)
@@ -67,29 +78,39 @@ def run_cell(acquire_window: int, batch_size: int, admit_cap: int,
         dt = time.perf_counter() - t0
         committed = int(np.asarray(state.stats["txn_cnt"])) - committed_before
         tputs.append(committed / dt)
-    tput = float(np.median(tputs))
-    if with_summary:
-        return tput, eng.summary(state)
-    return tput
+        cpt.append(committed / n_ticks)
+    return float(np.median(tputs)), float(np.median(cpt))
 
 
 def main():
-    # admit_cap=1024 is a tuned concurrency throttle for BOTH cells: in the
-    # greedy cell it holds steady-state in-flight txns low enough that the
-    # abort rate stays ~0.16 (uncapped admission drives contention up and
-    # measures ~280k/s vs ~430k/s capped; sweep in PROFILE.md)
-    faithful = run_cell(acquire_window=1, batch_size=8192, admit_cap=1024)
-    greedy = run_cell(acquire_window=10, batch_size=8192, admit_cap=1024)
     per_chip_star = NORTH_STAR_CLUSTER / NORTH_STAR_CHIPS
+    faithful, _ = run_cell(Config(cc_alg="NO_WAIT", acquire_window=1,
+                                  **YCSB_KW))
+    greedy, _ = run_cell(Config(cc_alg="NO_WAIT", acquire_window=10,
+                                **YCSB_KW))
+
+    # every algorithm's faithful cell + TPC-C, smaller measurement (the
+    # compile dominates; commits/tick is the stable number)
+    algs = {}
+    for alg in ("NO_WAIT", "WAIT_DIE", "TIMESTAMP", "MVCC", "OCC", "MAAT",
+                "CALVIN"):
+        t, c = run_cell(Config(cc_alg=alg, acquire_window=1, **YCSB_KW),
+                        n_ticks=200, windows=3)
+        algs[alg] = {"tput": round(t, 1), "commits_per_tick": round(c, 1)}
+    t, c = run_cell(Config(**TPCC_KW), n_ticks=100, windows=3)
+    algs["TPCC_MVCC_64wh"] = {"tput": round(t, 1),
+                              "commits_per_tick": round(c, 1)}
+
     print(json.dumps({
         "metric": "ycsb_nowait_zipf0.6_tput_faithful",
         "value": round(float(faithful), 1),
         "unit": "committed_txns_per_sec",
         "vs_baseline": round(float(faithful) / per_chip_star, 4),
         "greedy_tput": round(float(greedy), 1),
+        "algs": algs,
         "note": "value=acquire_window 1 (reference-faithful); greedy_tput="
                 "window 10; vs_baseline = faithful / (1M-cluster north star"
-                " / 8 chips)",
+                " / 8 chips); algs[*].commits_per_tick is chip-noise-immune",
     }))
 
 

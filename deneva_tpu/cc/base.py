@@ -86,6 +86,22 @@ class CCPlugin:
     #: reservations (the RFIN(abort) release of a prepared participant,
     #: worker_thread.cpp:302-343).  OCC sets this (its prepare marks).
     release_on_vabort: bool = False
+    #: MaaT: the commit exchange (RFIN) applies the commit-time forward
+    #: validation at each owner — pushes onto row members the committer
+    #: never saw (row_maat.cpp:208-307) happen only for txns that COMMIT
+    #: globally, exactly like the reference; a validator that voted yes
+    #: locally but lost 2PC must not land them.  The sharded engine then
+    #: runs `commit_forward_entries` at exchange B over the A-phase live
+    #: view and ships the pushed bounds home on a third exchange leg.
+    commit_forward_push: bool = False
+    #: (lower_field, upper_field) db keys the commit-time pushes merge into
+    forward_push_fields: tuple[str, str] = ()
+
+    def commit_forward_entries(self, cfg: Config, c, l):
+        """Owner-side commit-time pushes: c/l are dicts of committed-entry
+        and live-entry lanes (see parallel/sharded.py call site).  Returns
+        (lower_push, upper_push) per live lane."""
+        raise NotImplementedError
 
     def home_commit_check(self, cfg: Config, db: dict, txn: TxnState,
                           commit_try: jnp.ndarray) -> jnp.ndarray:

@@ -19,26 +19,56 @@ arithmetic happens at validation/commit, one batched pass per tick:
   lower > snapshot gr.  Using access-time snapshots (not commit-time values)
   matters: a writer that committed AFTER my access must push my upper DOWN
   (I read the old value), not my lower up.
-- cases 2/4/5 against VALIDATED/COMMITTED neighbors (maat.cpp:49-110):
-  committed neighbors already pushed my bounds at their commit (forward
-  validation below); same-tick finishers are serialized by ts and act
-  VALIDATED toward later finishers via per-row prefix reductions over their
-  pre-tick bounds.
-- neighbor squeeze at successful validation + commit-time forward
-  validation (maat.cpp:121-157, row_maat.cpp:208-307) are consolidated into
-  one pass — in a synchronous tick the live set at validation and at commit
-  is identical: for each committing txn T, live readers of rows T wrote get
-  upper <= T.lower-1, and live writers of rows T read or wrote get
-  lower >= T.upper+1.
+- cases 2/4/5 (maat.cpp:49-110) check the txn's access-time snapshot SETS
+  against members now VALIDATED/COMMITTED.  In the synchronous tick those
+  members are exactly the same-tick validators with smaller ts — the
+  reference deletes a committed TimeTable entry (txn.cpp:431), so an
+  earlier validator influences a later one ONLY through the pushes it
+  applied while validating/committing.  Those pushes depend on per-row
+  ACCESS order (membership in the pusher's snapshot sets):
+    target X accessed row k BEFORE pusher P (X in P's sets; P's
+    before/after squeeze, maat.cpp:121-157):
+      X writer  ->  X.lower >= P.upper + 1
+      X reader  ->  X.upper <= P.lower - 1
+    target X accessed AFTER P (X unseen; P's commit-time forward
+    validation, row_maat.cpp:208-307):
+      P wrote k ->  X.upper <= P.lower - 1   (writers AND readers)
+      P read k, X writer -> X.lower >= P.lower + 1
+  Access order is computable without extra state because MaaT accesses
+  never block: access r granted at start_tick + r//window; in-tick ties
+  resolve by ts (the sequential access phase runs in ts order).  Reader
+  targets receive the same bound in both directions, so their cap is an
+  exact prefix scan; writer targets consult the nearest
+  maat_chain_window-1 earlier validators pairwise (Config).
+- the self-adjustments a validator makes before pushing (its upper ducks
+  under seen running writers, maat.cpp:145-152; its lower jumps above
+  seen running readers, maat.cpp:121-127 — sparing them the push) are
+  applied from per-row access-order prefixes.
 - commit_ts = final lower (find_bound, maat.cpp:176-190); rows written get
   lw = max(lw, commit_ts), rows read get lr = max(lr, commit_ts).
 
-Known divergences (documented, parity measured by abort rates): snapshot
-*sets* are not tracked per txn — the live join at validation approximates
-"was in the row's uncommitted set at my access time"; the reference's
-commit-time push of unknown-writer uppers (row_maat.cpp:222-233), which
-orders writers it never observed BEFORE itself, is dropped in favor of the
-validation-side after-squeeze (both directions would conflict).
+Sharded (node_cnt > 1): the reference keeps a TimeTable PER NODE synced
+by Ack/finish ride-alongs, so validation is per-owner on local views —
+a txn locally VALIDATED at one owner pushes there even when 2PC aborts
+it elsewhere, a validator mid-2PC stays VALIDATED in the local table
+(later validators hit cases 2/4/5 against it: lower >= its upper+1 for
+writer targets), and commit-time forward validation runs at the RFIN
+round for globally-committed txns only (commit_forward_entries, wired
+at the commit exchange with a third return leg).  The oracle replays
+the same per-owner protocol (oracle/sequential.py MaatManager).
+
+Known divergences (documented, parity measured by abort rates): the
+pairwise chain drops pusher/target pairs farther than maat_chain_window-1
+validator ranks apart on one row-tick (counted in
+maat_chain_overflow_cnt); cross-row mid-chain bound propagation is
+iterated to a fixed point rather than interleaved in global ts order; the
+self-adjustment ducks use pre-chain bounds of running neighbors; the
+reader-jump (maat.cpp:121-127) gates its aggregated MAX candidate once
+against the committer's upper instead of per candidate, so a single
+overshooting reader suppresses the whole jump where the reference would
+still take the smaller candidates; sharded,
+pushes applied at different owners within one tick (or one net-delay
+transit window) become mutually visible only at the next home merge.
 """
 
 from __future__ import annotations
@@ -65,6 +95,8 @@ class Maat(CCPlugin):
                     "maat_gw": "max", "maat_gr": "max"}
     commit_ts_field = "maat_lower"
     ship_access_tick = True
+    commit_forward_push = True
+    forward_push_fields = ("maat_lower", "maat_upper")
 
     def init_db(self, cfg: Config, n_rows: int, B: int, R: int) -> dict:
         db = {
@@ -81,11 +113,22 @@ class Maat(CCPlugin):
         # the flush cond copies both 64 MB carries (~1.9 ms) vs the
         # ~2.4 ms the direct scatters cost (PROFILE.md round 4).
 
-        # validation case counters (the maat_case1-6 families of
-        # maat.cpp:46-111 / statistics/stats.h), warmup-gated like
-        # INC_STATS; db scalars ending in _cnt surface into [summary]
-        for k in ("maat_case1_cnt", "maat_case2_cnt", "maat_case3_cnt",
-                  "maat_case4_cnt", "maat_case6_cnt"):
+        # validation counters, warmup-gated like INC_STATS; db scalars
+        # ending in _cnt surface into [summary].  maat_case1/maat_case3
+        # are the reference families (maat.cpp:46-48,68-70 /
+        # statistics/stats.h).  The reference's case2/4/5 counters fire
+        # against snapshot members still VALIDATED at validation time —
+        # a state that exists only between validate and commit, which the
+        # synchronous tick consolidates — so their work is counted here
+        # under non-reference names: maat_chain_cap_cnt (upper tightened
+        # by the same-tick chain), maat_chain_push_cnt (lower raised),
+        # maat_range_abort_cnt (range emptied -> abort; the reference has
+        # no counter for this, it shows as cc_vabort), and
+        # maat_chain_overflow_cnt (row-ticks whose validator count
+        # exceeded Config.maat_chain_window).
+        for k in ("maat_case1_cnt", "maat_case3_cnt", "maat_chain_cap_cnt",
+                  "maat_chain_push_cnt", "maat_range_abort_cnt",
+                  "maat_chain_overflow_cnt"):
             db[k] = jnp.zeros((), jnp.int32)
         return db
 
@@ -133,7 +176,8 @@ class Maat(CCPlugin):
         return (AccessDecision(grant=req, wait=z, abort=z),
                 {**db, "maat_gw": gw, "maat_gr": gr})
 
-    def validate(self, cfg: Config, db: dict, txn: TxnState, finishing, tick):
+    def validate(self, cfg: Config, db: dict, txn: TxnState, finishing, tick,
+                 prepared=None):
         B, R = txn.keys.shape
         n = B * R
 
@@ -148,36 +192,17 @@ class Maat(CCPlugin):
         key = jnp.where(ent_live, txn.keys.reshape(-1), NULL_KEY)
         ts = jnp.broadcast_to(txn.ts[:, None], (B, R)).reshape(-1)
         iw = txn.is_write.reshape(-1)
-        tx = jnp.broadcast_to(
-            jnp.arange(B, dtype=jnp.int32)[:, None], (B, R)).reshape(-1)
-
+        # per-entry access tick: MaaT accesses never block, so access r was
+        # granted at start_tick + r//window; in-tick ties resolve by ts
+        # (the sequential access phase runs in ts order)
+        atick = (jnp.broadcast_to(txn.start_tick[:, None], (B, R))
+                 + ridx // max(cfg.acquire_window, 1)).reshape(-1)
         orig = jnp.arange(n, dtype=jnp.int32)
-        (skey, sts), (s_iw, s_fin, s_tx, s_orig) = seg.sort_by(
-            (key, ts), (iw, fin_e, tx, orig))
-        starts = seg.segment_starts(skey)
 
         # saturating +-1 (the reference pins at 0 / UINT64_MAX,
         # maat.cpp:57-62,81-86; int32 wraparound would erase the push)
         up1 = lambda v: jnp.minimum(v, BIG_TS - 1) + 1
         dn1 = lambda v: jnp.maximum(v, 1) - 1
-
-        def to_sorted(*vals_B):
-            """Broadcast per-txn (B,) values to entries and permute into
-            this sort's order by re-sorting on the same fixed keys — on
-            TPU one extra sort is ~4x cheaper than the per-lane
-            valid[s_tx]-style gathers it replaces (PROFILE.md).
-
-            PRECONDITION: (key, ts) ties are intra-txn only — timestamps
-            are unique per live txn — so this is_stable=False re-sort can
-            only permute lanes WITHIN one txn's run, and only per-txn-
-            constant payloads may ship through it (a per-lane-varying
-            payload, or a future duplicate-ts scheme, would silently
-            misalign tie groups; checked when debug_invariants is on)."""
-            pay = tuple(jnp.broadcast_to(v[:, None].astype(jnp.int32),
-                                         (B, R)).reshape(-1)
-                        for v in vals_B)
-            out = jax.lax.sort((key, ts) + pay, num_keys=2, is_stable=False)
-            return out[2:]
 
         def txn_reduce(perm, sorted_val, op):
             """Per-txn reduction over sorted entries: un-permute to entry
@@ -193,55 +218,203 @@ class Maat(CCPlugin):
         case3 = finishing & has_write & (lower <= db["maat_gr"])
         lower = jnp.where(finishing & has_write,
                           jnp.maximum(lower, db["maat_gr"] + 1), lower)
+        upper0 = db["maat_upper"]
 
-        # Same-tick earlier validators are already COMMITTED AND RELEASED
-        # by the time I validate (validation is serialized and
-        # TimeTable::release runs at commit, txn.cpp:431), so cases 2/4/5
-        # IGNORE them.  What binds me instead is the push they applied as
-        # they committed (validation squeeze + commit-time forward
-        # validation, row_maat.cpp:189-314), with commit_ts = their final
-        # lower:
-        #   committed WRITER of a row I touch  -> my upper <= cts - 1
-        #   committed READER of a row I write  -> my lower >= cts + 1
-        # (same-tick finishers were admitted together, so in ts order the
-        # later finisher accessed each shared row after the earlier one —
-        # the "unseen neighbor" direction of the forward push).  Each
-        # push uses the NEIGHBOR's final lower, which itself depends on
-        # pushes from even-earlier validators -> compute the unique fixed
-        # point of the ts-ordered chain.
+        if prepared is not None:
+            # VALIDATED-but-uncommitted neighbors (2PC prepare window,
+            # net_delay mode): they sit VALIDATED in the owner's
+            # TimeTable, so a new validator's cases 2/4/5 fire against
+            # any of them that accessed the shared row BEFORE it (they
+            # are in its snapshot sets), with their (static) validated
+            # bounds:
+            #   prepared WRITER of a row I read  -> upper <= its lower-1
+            #   prepared member of a row I write -> lower >= its upper+1
+            # Static per-entry prefix scans in access order; results fold
+            # into the chain's base bounds.
+            prep_e = prepared[:, None] if prepared.ndim == 1 else prepared
+            prep_e = (jnp.broadcast_to(prep_e, (B, R))
+                      & granted & live_txn[:, None]).reshape(-1)
+            lo_b = jnp.broadcast_to(db["maat_lower"][:, None],
+                                    (B, R)).reshape(-1)
+            up_b = jnp.broadcast_to(db["maat_upper"][:, None],
+                                    (B, R)).reshape(-1)
+            (k5, a5, t5), (w5, p5, lo5, up5, f5, orig5) = seg.sort_by(
+                (key, atick, ts),
+                (iw, prep_e, lo_b, up_b, fin_e, orig))
+            st5 = seg.segment_starts(k5)
+            pre_pw = seg.seg_prefix_min(
+                jnp.where(p5 & w5, dn1(lo5), BIG_TS), st5, BIG_TS)
+            pre_pa = seg.seg_prefix_max(
+                jnp.where(p5, up1(up5), 0), st5, 0)
+            cap5 = jnp.where(f5 & ~w5, pre_pw, BIG_TS)
+            push5 = jnp.where(f5 & w5, pre_pa, 0)
+            cap_p, push_p = seg.unpermute_many(orig5, cap5, push5)
+            upper0 = jnp.minimum(upper0,
+                                 cap_p.reshape(B, R).min(axis=1))
+            lower = jnp.maximum(lower, push_p.reshape(B, R).max(axis=1))
+            prep_flag = prep_e
+        else:
+            prep_flag = jnp.zeros(n, dtype=bool)
         static_lower = lower
 
-        # exclude my own entries from the prefix pushes (a txn never pushes
-        # itself; also keeps the fixed point free of self-oscillation on
-        # duplicate-key txns): read the prefix value at my (key, txn)-run
-        # start
-        run_start = starts | seg.segment_starts(s_tx)
+        # ---- same-tick commit chain, access-order aware ----
+        # An earlier validator P influences a later one X only through the
+        # pushes it applied while validating/committing (its TimeTable
+        # entry is deleted at commit, txn.cpp:431); the push direction
+        # depends on whether X accessed the shared row before P (P's
+        # before/after squeeze, maat.cpp:121-157) or after P (P's commit-
+        # time forward validation, row_maat.cpp:208-307) — see module
+        # docstring for the formula table.  Each push uses P's FINAL
+        # bounds, which themselves depend on earlier pushes -> compute the
+        # fixed point of the ts-ordered chain.
+        #
+        # Sort: finishing entries first within each row, in validation
+        # (ts) order; runner entries follow and never pollute the prefix.
+        nf = jnp.where(fin_e, 0, 1).astype(jnp.int32)
+        (k3, nf3, t3), (iw3i, at3, orig3) = seg.sort_by(
+            (key, nf, ts), (iw.astype(jnp.int32), atick, orig))
+        iw3 = iw3i == 1
+        st3 = seg.segment_starts(k3)
+        fin3 = (nf3 == 0) & (k3 != NULL_KEY)
+        # my (key, txn)-run start: same txn's entries on one key share ts
+        run_start3 = st3 | (t3 != jnp.roll(t3, 1))
+        M = max(int(cfg.maat_chain_window), 1)
 
-        def caps(okv, lov):
-            s_ok, s_lo = to_sorted(okv, lov)
-            okx = (s_ok == 1) & s_fin
+        # The pair window's STATIC classification is bit-packed — 2 bits
+        # per distance d — into one int32 lane array: 0 = no pair,
+        # 1 = concordant P-writer, 2 = concordant P-reader,
+        # 3 = discordant.  Materializing the ~7 boolean masks per
+        # distance instead made XLA hoist ~50 pred[B*R] arrays into the
+        # fixed-point while carry (a scoped-memory copy storm measured at
+        # several ms/tick on TPU); the packed word keeps the carry small
+        # and the per-step unpack is a free elementwise shift.
+        wcode = jnp.zeros(n, jnp.int32)
+        for d in range(1, min(M, 16)):
+            pair_s = (fin3 & iw3 & jnp.roll(fin3, d)
+                      & (jnp.roll(k3, d) == k3)
+                      & (jnp.roll(t3, d) != t3))
+            conc_s = jnp.roll(at3, d) <= at3
+            cls = jnp.where(
+                pair_s,
+                jnp.where(conc_s,
+                          jnp.where(jnp.roll(iw3, d), 1, 2), 3), 0)
+            wcode = wcode | (cls << (2 * (d - 1)))
+        # distances past 15 cannot pack into 2-bit lanes of one word;
+        # carry their masks directly (parity harnesses with W=64 trade
+        # carry size for exactness)
+        far = []
+        for d in range(16, M):
+            pair_s = (fin3 & iw3 & jnp.roll(fin3, d)
+                      & (jnp.roll(k3, d) == k3)
+                      & (jnp.roll(t3, d) != t3))
+            conc_s = jnp.roll(at3, d) <= at3
+            far.append(jnp.where(
+                pair_s,
+                jnp.where(conc_s,
+                          jnp.where(jnp.roll(iw3, d), 1, 2), 3), 0)
+                .astype(jnp.int8))
+
+        def to_chain(*vals_B):
+            """Broadcast per-txn (B,) values to entries and permute into
+            the chain sort's order by re-sorting on the same fixed keys —
+            on TPU one extra sort is ~4x cheaper than the per-lane
+            valid[s_tx]-style gathers it replaces (PROFILE.md).
+
+            PRECONDITION: (key, nf, ts) ties are intra-txn only — nf is
+            per-txn-constant and timestamps are unique per live txn — so
+            this is_stable=False re-sort can only permute lanes WITHIN one
+            txn's run, and only per-txn-constant payloads may ship
+            through it."""
+            pay = tuple(jnp.broadcast_to(v[:, None].astype(jnp.int32),
+                                         (B, R)).reshape(-1)
+                        for v in vals_B)
+            out = jax.lax.sort((key, nf, ts) + pay, num_keys=3,
+                               is_stable=False)
+            return out[3:]
+
+        def caps(okv, lov, upv):
+            s_ok, s_lo, s_up = to_chain(okv, lov, upv)
+            okf = (s_ok == 1) & fin3
+            # READER targets: every ok earlier validator that wrote the
+            # row caps my upper to its lower-1 in BOTH access orders (the
+            # before-push and the forward-val push coincide), so the cap
+            # is an exact ts-prefix scan at any multiplicity, excluding
+            # my own entries via the run-start trick.
             pmw_full = seg.seg_prefix_min(
-                jnp.where(okx & s_iw, dn1(s_lo), BIG_TS), starts, BIG_TS)
-            pmw = seg.at_run_start(pmw_full, run_start, starts, BIG_TS,
+                jnp.where(okf & iw3, dn1(s_lo), BIG_TS), st3, BIG_TS)
+            pmw = seg.at_run_start(pmw_full, run_start3, st3, BIG_TS,
                                    "min")
-            plr_full = seg.seg_prefix_max(
-                jnp.where(okx & ~s_iw, up1(s_lo), 0), starts, 0)
-            plr = seg.at_run_start(plr_full, run_start, starts, 0, "max")
-            cap_e = jnp.where(s_fin, pmw, BIG_TS)
-            push_e = jnp.where(s_fin & s_iw, plr, 0)
+            cap_e = jnp.where(fin3 & ~iw3, pmw, BIG_TS)
+            # WRITER targets: direction depends on per-row access order ->
+            # consult the nearest M-1 earlier validators pairwise.
+            #   accessed before P (discordant, I am in P's after set):
+            #     lower >= P.upper+1 — but P's upper first DUCKS under my
+            #     range when it can (maat.cpp:145-152: my upper-2 if
+            #     finite and in range, my lower-1 if my lower clears
+            #     P.lower+1), which usually turns the push into a no-op;
+            #     the duck is applied pair-locally here.
+            #   accessed after P (concordant, P is in MY sets): single-
+            #     shard, P committed+released before I validate, so its
+            #     commit-time forward validation applies (P wrote ->
+            #     upper <= P.lo-1; P read -> lower >= P.lo+1).  Sharded,
+            #     P sits in its 2PC prepare window still VALIDATED in the
+            #     owner's TimeTable, so cases 4/5 apply instead: lower >=
+            #     P.upper+1, raw (no duck — P is not at its own
+            #     validation); P's commit-direction pushes happen at the
+            #     commit exchange (commit_forward_entries) like the
+            #     reference's RFIN.
+            push_e = jnp.zeros_like(cap_e)
+            for d in range(1, M):
+                if d < 16:
+                    cls = (wcode >> (2 * (d - 1))) & 3
+                else:
+                    cls = far[d - 16].astype(jnp.int32)
+                cls = jnp.where(jnp.roll(okf, d), cls, 0)
+                p_lo = jnp.roll(s_lo, d)
+                p_up = jnp.roll(s_up, d)
+                c1 = jnp.where((s_up < BIG_TS) & (s_up > p_lo + 2)
+                               & (s_up < p_up), s_up - 2, BIG_TS)
+                c2 = jnp.where((s_lo > p_lo + 1) & (s_lo < p_up),
+                               s_lo - 1, BIG_TS)
+                p_up_eff = jnp.minimum(p_up, jnp.minimum(c1, c2))
+                if cfg.node_cnt > 1:
+                    push_d = jnp.where(cls == 3, up1(p_up_eff),
+                                       jnp.where(cls > 0, up1(p_up), 0))
+                else:
+                    cap_e = jnp.minimum(
+                        cap_e, jnp.where(cls == 1, dn1(p_lo), BIG_TS))
+                    push_d = jnp.where(
+                        cls == 2, up1(p_lo),
+                        jnp.where(cls == 3, up1(p_up_eff), 0))
+                push_e = jnp.maximum(push_e, push_d)
             # ONE unpermute sort ships both reductions home
-            up_e, lo_e = seg.unpermute_many(s_orig, cap_e, push_e)
-            upper_new = jnp.minimum(db["maat_upper"],
+            up_e, lo_e = seg.unpermute_many(orig3, cap_e, push_e)
+            upper_new = jnp.minimum(upper0,
                                     up_e.reshape(B, R).min(axis=1))
             lower_new = jnp.maximum(static_lower,
                                     lo_e.reshape(B, R).max(axis=1))
+            if R == 1 and cfg.node_cnt > 1:
+                # sharded virtual-entry context: the reference keeps ONE
+                # TimeTable record per (node, txn) — a push received on
+                # any of the txn's rows at this owner binds its entries
+                # on every other row here too.  Group-combine by home ts
+                # (unique per txn; dead lanes share the 0 group, and
+                # their bounds are never read).
+                gord = jnp.arange(B, dtype=jnp.int32)
+                (g1,), (glo, gup, gidx) = seg.sort_by(
+                    (txn.ts,), (lower_new, upper_new, gord))
+                gst = seg.segment_starts(g1)
+                glo = seg.seg_reduce(glo, gst, "max")
+                gup = seg.seg_reduce(gup, gst, "min")
+                lower_new, upper_new = seg.unpermute_many(gidx, glo, gup)
             return lower_new, upper_new
 
         def step(carry):
-            okv, lov, _up, _ = carry
-            lower_new, upper_new = caps(okv, lov)
+            okv, lov, upv, _ = carry
+            lower_new, upper_new = caps(okv, lov, upv)
             new_ok = finishing & (lower_new < upper_new)
-            changed = jnp.any(new_ok != okv) | jnp.any(lower_new != lov)
+            changed = (jnp.any(new_ok != okv) | jnp.any(lower_new != lov)
+                       | jnp.any(upper_new != upv))
             return new_ok, lower_new, upper_new, changed
 
         # SPECULATIVE UNROLL (PROFILE.md): the ts-ordered chain usually
@@ -250,24 +423,32 @@ class Maat(CCPlugin):
         # runs only for genuinely deeper chains.  `upper` rides the carry,
         # so no extra caps() pass is needed after convergence: the loop
         # exits exactly when a step reproduces its inputs.
-        ok, lower, upper, ch = step((finishing, static_lower,
-                                     db["maat_upper"],
+        ok, lower, upper, ch = step((finishing, static_lower, upper0,
                                      jnp.any(finishing) | True))
         ok, lower, upper, ch = step((ok, lower, upper, ch))
-        ok, lower, upper, _ = jax.lax.cond(
-            ch,
-            lambda op: jax.lax.while_loop(lambda c: c[3], step, op),
-            lambda op: op,
-            (ok, lower, upper, ch))
 
-        # case counters (maat.cpp:46-111 families): 1/3 snapshot pushes,
-        # 2 = upper capped by earlier validated writers, 4 = lower pushed
-        # by earlier validated readers, 6 = range emptied (abort).  Bumped
-        # once per VALIDATION EVENT: in the sharded virtual-entry context
-        # (R==1, entries of one home txn share a unique ts) a
-        # representative-entry mask keeps counts per (owner, txn), not
-        # per routed access; its per-entry bound values sample one owner
-        # view, like the reference's per-node validate.
+        def bounded_step(c):
+            okv, lov, upv, chv, it = c
+            okv, lov, upv, chv = step((okv, lov, upv, chv))
+            return okv, lov, upv, chv, it + 1
+
+        # iteration safety bound: the chain's ok-retraction makes it
+        # non-monotone in theory; 64 ranks resolve any chain seen in
+        # practice and a pathological cycle exits instead of hanging
+        ok, lower, upper, _, _ = jax.lax.cond(
+            ch,
+            lambda op: jax.lax.while_loop(
+                lambda c: c[3] & (c[4] < 64), bounded_step, op),
+            lambda op: op,
+            (ok, lower, upper, ch, jnp.zeros((), jnp.int32)))
+
+        # counters: maat_case1/3 are the reference families (snapshot
+        # pushes, maat.cpp:46-48,68-70); the chain/abort counters are
+        # inventions (see init_db).  Bumped once per VALIDATION EVENT: in
+        # the sharded virtual-entry context (R==1, entries of one home txn
+        # share a unique ts) a representative-entry mask keeps counts per
+        # (owner, txn), not per routed access; its per-entry bound values
+        # sample one owner view, like the reference's per-node validate.
         measuring = tick >= cfg.warmup_ticks
         if R == 1 and cfg.node_cnt > 1:
             gord = jnp.arange(B, dtype=jnp.int32)
@@ -279,14 +460,21 @@ class Maat(CCPlugin):
             rep = finishing
         cnt = lambda m: jnp.where(measuring,
                                   jnp.sum((m & rep).astype(jnp.int32)), 0)
+        # row-ticks whose validator count exceeds the pair window (their
+        # farthest writer-target pairs were dropped)
+        nfin_seg = seg.seg_reduce(fin3.astype(jnp.int32), st3, "sum")
+        ovf = jnp.where(measuring & (M < B),
+                        jnp.sum((st3 & (nfin_seg > M)).astype(jnp.int32)),
+                        0)
         case_inc = {
             "maat_case1_cnt": db["maat_case1_cnt"] + cnt(case1),
             "maat_case3_cnt": db["maat_case3_cnt"] + cnt(case3),
-            "maat_case2_cnt": db["maat_case2_cnt"]
+            "maat_chain_cap_cnt": db["maat_chain_cap_cnt"]
             + cnt(upper < db["maat_upper"]),
-            "maat_case4_cnt": db["maat_case4_cnt"]
+            "maat_chain_push_cnt": db["maat_chain_push_cnt"]
             + cnt(lower > static_lower),
-            "maat_case6_cnt": db["maat_case6_cnt"] + cnt(~ok),
+            "maat_range_abort_cnt": db["maat_range_abort_cnt"] + cnt(~ok),
+            "maat_chain_overflow_cnt": db["maat_chain_overflow_cnt"] + ovf,
         }
 
         # --- directional neighbor squeeze: consolidation of the validation
@@ -304,59 +492,92 @@ class Maat(CCPlugin):
         #     (upper <= C.lower - 1)
         # Access order is computable without extra state because MaaT
         # accesses never block: access r granted at start_tick + r//window.
-        atick = (jnp.broadcast_to(txn.start_tick[:, None], (B, R))
-                 + ridx // max(cfg.acquire_window, 1)).reshape(-1)
-        # running entries carry their CURRENT db bounds; committing entries
+        # Running entries carry their CURRENT db bounds; committing entries
         # their final validated bounds — shipped through the sort as
         # payloads instead of gathered per lane afterwards
         lo_cur = jnp.where(finishing, lower, db["maat_lower"])
         up_cur = jnp.where(finishing, upper, db["maat_upper"])
         bcast = lambda v: jnp.broadcast_to(
             v[:, None].astype(jnp.int32), (B, R)).reshape(-1)
-        (k2, a2, t2), (w2, f2, ok2, lo2, up2, orig2) = seg.sort_by(
+        (k2, a2, t2), (w2, f2, p2, ok2, lo2, up2, orig2) = seg.sort_by(
             (key, atick, ts),
-            (iw, fin_e, bcast(ok), bcast(lo_cur), bcast(up_cur), orig))
+            (iw, fin_e, prep_flag, bcast(ok), bcast(lo_cur), bcast(up_cur),
+             orig))
         st2 = seg.segment_starts(k2)
         live2 = k2 != NULL_KEY
         okx = ok2 == 1
         cw = live2 & f2 & w2 & okx          # committing writers
         cr = live2 & f2 & ~w2 & okx         # committing readers
-        run2 = live2 & ~f2                  # live, not finishing
+        # live, not finishing, not VALIDATED-pending: prepared entries
+        # are no longer RUNNING in the owner's TimeTable — the squeeze's
+        # before/after sets never contain them, and they are not duck
+        # candidates (reference state checks, maat.cpp:63,87,108)
+        run2 = live2 & ~f2 & ~p2
 
-        # validator self-adjustment before the after-push (maat.cpp:145-156):
-        # a committer's upper ducks under the range of a running writer it
-        # SAW (prefix in access order) when possible, weakening that push
+        # validator self-adjustments before the pushes: the committer's
+        # upper ducks under the range of a running WRITER it saw — both
+        # reference candidate formulas, W.upper-2 when finite AND
+        # W.lower-1 (maat.cpp:145-152) — and its lower jumps ABOVE the
+        # upper of a running READER it saw when there is room
+        # (maat.cpp:121-127), which spares that reader the before-push.
+        # "Saw" = the neighbor's access precedes the committer's (prefix
+        # in access order): only then is it in the committer's sets.
         cand = jnp.where(run2 & w2,
-                         jnp.where(up2 < BIG_TS, up2 - 2,
-                                   jnp.where(lo2 > 1, lo2 - 1, BIG_TS)),
+                         jnp.minimum(
+                             jnp.where(up2 < BIG_TS, up2 - 2, BIG_TS),
+                             jnp.where(lo2 > 1, lo2 - 1, BIG_TS)),
                          BIG_TS)
         pre_cand = seg.seg_prefix_min(cand, st2, BIG_TS)
         adj = txn_reduce(orig2, jnp.where(live2 & f2, pre_cand, BIG_TS),
                  "min")
+        cand_r = jnp.where(run2 & ~w2, up1(up2), 0)
+        pre_cand_r = seg.seg_prefix_max(cand_r, st2, 0)
+        # the reader-jump is gated per committer: only rows it WROTE (the
+        # before set comes from prewrites), and only while it stays below
+        # its (pre-duck) upper
+        adj_lo = txn_reduce(orig2, jnp.where(live2 & f2 & w2,
+                                             pre_cand_r, 0), "max")
+        lower_v = jnp.where(ok & (adj_lo > lower) & (adj_lo < upper),
+                            adj_lo, lower)
         upper_v = jnp.where(ok, jnp.maximum(jnp.minimum(upper, adj),
-                                            lower + 1), upper)
-        # re-sort shipping (same precondition as to_sorted: ts unique per
-        # txn, payload per-txn-constant)
-        _, _, _, up2c = jax.lax.sort((key, atick, ts, bcast(upper_v)),
-                                     num_keys=3, is_stable=False)
+                                            lower_v + 1), upper)
+        # re-sort shipping of BOTH ducked bounds (same precondition as
+        # to_chain: ts unique per txn, payload per-txn-constant)
+        _, _, _, up2c, lo2c = jax.lax.sort(
+            (key, atick, ts, bcast(upper_v), bcast(lower_v)),
+            num_keys=3, is_stable=False)
 
         # committers AFTER me in access order saw my entry (I was in their
-        # uncommitted sets): their validation orders me AFTER them.
-        # Committers BEFORE me never saw me: their commit-push orders me
-        # BEFORE them (writers) / AFTER commit_ts (readers).
+        # uncommitted sets): their VALIDATION squeeze orders me AFTER them
+        # — applied here, by locally-ok validators, regardless of their
+        # eventual 2PC fate (the reference's per-node validate pushes are
+        # never retracted).  Committers BEFORE me never saw me: their
+        # COMMIT-time forward validation orders me BEFORE them (writers) /
+        # AFTER commit_ts (readers) — single-shard consolidates it here
+        # (the ok set IS the commit set); the sharded engine instead
+        # applies it at the commit exchange for globally-committed txns
+        # only (commit_forward_entries below), like the reference's RFIN.
         suf_up_cw = seg.seg_suffix_max(jnp.where(cw, up1(up2c), 0), st2, 0)
         suf_up_cr = seg.seg_suffix_max(jnp.where(cr, up1(up2c), 0), st2, 0)
-        pre_lo_cr = seg.seg_prefix_max(jnp.where(cr, up1(lo2), 0), st2, 0)
-        pre_lo_cw = seg.seg_prefix_min(jnp.where(cw, dn1(lo2), BIG_TS),
+        suf_lo_cw = seg.seg_suffix_min(jnp.where(cw, dn1(lo2c), BIG_TS),
                                        st2, BIG_TS)
-        all_lo_cw = seg.seg_min_where(dn1(lo2), cw, st2, BIG_TS)
+        if cfg.node_cnt > 1:
+            pre_lo_cr = jnp.zeros_like(suf_up_cr)
+            pre_lo_cw = jnp.full_like(suf_lo_cw, BIG_TS)
+        else:
+            pre_lo_cr = seg.seg_prefix_max(jnp.where(cr, up1(lo2c), 0),
+                                           st2, 0)
+            pre_lo_cw = seg.seg_prefix_min(
+                jnp.where(cw, dn1(lo2c), BIG_TS), st2, BIG_TS)
 
         # running writers: ordered after committers that saw them, before
         # committing writers that did not
         w_lo = jnp.maximum(jnp.maximum(suf_up_cw, suf_up_cr), pre_lo_cr)
         w_up = pre_lo_cw
         # running readers: before every committing writer of the row
-        r_up = all_lo_cw
+        # (spared automatically when the committer's lower jumped above
+        # their upper: the min against lower-1 is then a no-op)
+        r_up = jnp.minimum(suf_lo_cw, pre_lo_cw)
 
         new_lo2 = jnp.where(run2 & w2, w_lo, 0)
         new_up2 = jnp.where(run2, jnp.where(w2, w_up, r_up), BIG_TS)
@@ -366,12 +587,64 @@ class Maat(CCPlugin):
                                 up_e2.reshape(B, R).min(axis=1))
         lower_arr = jnp.maximum(db["maat_lower"],
                                 lo_e2.reshape(B, R).max(axis=1))
-        # also persist the validators' own tightened bounds
+        # also persist the validators' own tightened bounds (lower_v is
+        # the commit_ts find_bound reads)
         upper_arr = jnp.where(finishing, upper_v, upper_arr)
-        lower_arr = jnp.where(finishing, lower, lower_arr)
+        lower_arr = jnp.where(finishing, lower_v, lower_arr)
 
         return ok, {**db, **case_inc,
                     "maat_lower": lower_arr, "maat_upper": upper_arr}
+
+    def commit_forward_entries(self, cfg: Config, c: dict, l: dict):
+        """Commit-time forward validation at the owner (RFIN processing,
+        row_maat.cpp:208-307): a GLOBALLY-committed txn pushes the row
+        members it never saw — those whose access came after its own
+        (strictly later atick, or same tick with later ts).  Per live
+        entry X and committed entry C on the same row:
+          C wrote, X writer -> X.upper <= cts - 1
+          C wrote, X reader -> X.upper <= C.local_lower - 1 (the owner's
+            TimeTable lower, row_maat.cpp:283 — shipped per entry)
+          C read,  X writer -> X.lower >= cts + 1
+        Sorting commit+live lanes together by (key, atick, ts) makes
+        "accessed after C" a prefix relation, so the dominance reductions
+        are exact segmented scans at any multiplicity.  A committer's own
+        live image ties with its commit lane and lands in its prefix —
+        that self-push is harmless (the slot frees this tick and
+        on_start resets bounds on reuse).
+
+        c: committed-entry lanes {key, cts, iw, atick, ts, loclo}, mask
+           `commit`; l: live-entry lanes {key, iw, atick, ts}, mask
+           `live`.  Returns (lo_push, up_push) aligned to l's lanes."""
+        up1 = lambda v: jnp.minimum(v, BIG_TS - 1) + 1
+        dn1 = lambda v: jnp.maximum(v, 1) - 1
+        nC = c["key"].shape[0]
+        nL = l["key"].shape[0]
+        cm = c["commit"]
+        key = jnp.concatenate([jnp.where(cm, c["key"], NULL_KEY),
+                               jnp.where(l["live"], l["key"], NULL_KEY)])
+        atick = jnp.concatenate([c["atick"], l["atick"]])
+        ts = jnp.concatenate([c["ts"], l["ts"]])
+        iw = jnp.concatenate([c["iw"], l["iw"]])
+        isc = jnp.concatenate([cm, jnp.zeros(nL, bool)])
+        cts = jnp.concatenate([c["cts"], jnp.zeros(nL, jnp.int32)])
+        loclo = jnp.concatenate([c["loclo"], jnp.zeros(nL, jnp.int32)])
+        orig = jnp.arange(nC + nL, dtype=jnp.int32)
+        (k4, a4, t4), (iw4, isc4, cts4, lo4, orig4) = seg.sort_by(
+            (key, atick, ts), (iw, isc, cts, loclo, orig))
+        st4 = seg.segment_starts(k4)
+        live4 = (k4 != NULL_KEY) & ~isc4
+        # prefix over committed entries strictly before me in access order
+        pre_up_w = seg.seg_prefix_min(
+            jnp.where(isc4 & iw4, dn1(cts4), BIG_TS), st4, BIG_TS)
+        pre_up_r = seg.seg_prefix_min(
+            jnp.where(isc4 & iw4, dn1(lo4), BIG_TS), st4, BIG_TS)
+        pre_lo_r = seg.seg_prefix_max(
+            jnp.where(isc4 & ~iw4, up1(cts4), 0), st4, 0)
+        up_push4 = jnp.where(live4,
+                             jnp.where(iw4, pre_up_w, pre_up_r), BIG_TS)
+        lo_push4 = jnp.where(live4 & iw4, pre_lo_r, 0)
+        up_e, lo_e = seg.unpermute_many(orig4, up_push4, lo_push4)
+        return lo_e[nC:], up_e[nC:]
 
     def home_commit_check(self, cfg: Config, db: dict, txn: TxnState,
                           commit_try):

@@ -181,6 +181,18 @@ class Config:
     ts_twr: bool = False              # TS_TWR Thomas write rule (config.h:123)
     his_recycle_len: int = 8          # HIS_RECYCLE_LEN: MVCC version-ring slots
 
+    #: MaaT same-tick commit-chain pair window (cc/maat.py): validators
+    #: finishing in the same tick on the same row push each other with
+    #: formulas that depend on per-row ACCESS order (maat.cpp before/after
+    #: squeeze vs row_maat.cpp commit-time forward validation).  Reader
+    #: targets are handled exactly by prefix scans at any multiplicity;
+    #: writer targets consult the nearest maat_chain_window-1 earlier
+    #: validators pairwise (exact when <= maat_chain_window validators
+    #: share a row in one tick; beyond that the farthest pairs drop and
+    #: maat_chain_overflow_cnt counts the affected row-ticks).  Parity
+    #: harnesses raise it; 8 covers >99% of row-ticks at paper skews.
+    maat_chain_window: int = 8
+
     # --- logging / replication (reference config.h:147 LOGGING,
     # :24-27 REPLICA_CNT; system/logger.cpp, worker_thread.cpp:527-554) ---
     logging: bool = False        # command log gating commit (off by default,

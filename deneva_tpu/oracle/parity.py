@@ -21,6 +21,12 @@ from deneva_tpu.workloads import ycsb
 
 _KEYS = ("txn_cnt", "total_txn_abort_cnt", "abort_rate", "write_cnt")
 
+#: per-algorithm refinement knobs the PUBLISHED parity cells run at —
+#: the single source for tests/test_parity.py, tests/test_netdelay.py and
+#: experiments/parity_report.py.  MaaT widens the same-tick chain window
+#: past the worst row-tick validator multiplicity so no pair drops.
+PARITY_EXTRA = {"MAAT": dict(maat_chain_window=64)}
+
 
 def _pair_dict(cfg: Config, b: dict, b_data_sum: int, seq) -> dict:
     s = seq.summary()
@@ -38,17 +44,12 @@ def _pair_dict(cfg: Config, b: dict, b_data_sum: int, seq) -> dict:
 def run_pair(cfg: Config, n_ticks: int) -> dict:
     """Run both engines on one shared pool; return their stats + divergence.
 
-    The oracle replays any QueryPool's (keys, is_write) footprints, so
-    TPC-C / PPS parity cells come for free — EXCEPT paths the oracle does
-    not model: workload user-aborts (TPC-C rbk) and the Calvin recon
-    deferral.  Such configs are rejected so a schedule mismatch can't be
-    misread as CC-kernel divergence."""
+    The oracle replays any QueryPool's (keys, is_write) footprints,
+    workload user-aborts (TPC-C rbk, via pool_user_abort flags) and the
+    Calvin recon deferral (shadow read pass + one-tick epoch delay), so
+    TPC-C / PPS / CALVIN+PPS / rbk>0 parity cells all run."""
     from deneva_tpu import workloads as wl_registry
     workload = wl_registry.get(cfg)
-    assert cfg.tpcc_rbk_perc == 0, \
-        "oracle does not model user-aborts; parity needs rbk off"
-    assert not (cfg.cc_alg == "CALVIN" and workload.recon_types), \
-        "oracle does not model the Calvin recon deferral"
     pool = workload.gen_pool(cfg)
 
     eng = Engine(cfg, pool=pool)

@@ -56,6 +56,9 @@ class SeqTxn:
     keys: np.ndarray = None
     is_write: np.ndarray = None
     n_req: int = 0
+    ttype: int = 0      # workload program id (pool.txn_type)
+    rbk: bool = False   # user-aborts at finish (TPC-C NewOrder rollback)
+    shadow: bool = False  # Calvin recon deferral: shadow read pass active
     # MaaT per-txn state (system/txn.h uncommitted_* sets, gr/gw snapshots)
     maat = None
     # --- net_delay mode (Config.net_delay_ticks > 0) ---
@@ -77,6 +80,21 @@ class Manager:
 
     def on_start(self, txn: SeqTxn):
         pass
+
+    def flush_tick(self):
+        """End-of-tick hook (per-owner state merge; MaaT overrides)."""
+
+    def commit_check(self, txn) -> bool:
+        """Coordinator re-check when the delayed commit round applies
+        (net_delay mode): pushes landing during the prepare/commit
+        transit can still invalidate the txn (MaaT find_bound)."""
+        return True
+
+    def user_release(self, txn):
+        """Release CC state for a workload rollback (TPC-C rbk): like an
+        abort for every algorithm with an abort path; Calvin overrides
+        (its queue entries withdraw without the abort machinery)."""
+        self.abort(txn)
 
     def access(self, txn: SeqTxn, key: int, iw: bool) -> str:
         raise NotImplementedError
@@ -162,6 +180,14 @@ class CalvinManager(Manager):
             q = self.queues.get(int(txn.keys[r]))
             if q is not None:
                 q[:] = [e for e in q if e[1] != txn.slot]
+
+    def drop(self, txn):
+        """Withdraw every queued request (the recon shadow pass's
+        transient read locks vanish at tick end — the engine's shadow
+        entries simply stop shipping)."""
+        self.commit(txn, None)
+
+    user_release = drop
 
     def abort(self, txn):  # pragma: no cover - Calvin never aborts
         raise AssertionError("Calvin aborted")
@@ -377,111 +403,208 @@ class MaatTxn:
     state: str = "RUNNING"     # RUNNING/VALIDATED/COMMITTED/ABORTED
     gr: int = 0
     gw: int = 0
-    uw: set = dataclasses.field(default_factory=set)    # writers of my reads
-    ur: set = dataclasses.field(default_factory=set)    # readers of my writes
-    uwy: set = dataclasses.field(default_factory=set)   # writers of my writes
+    # access-time set copies, PER OWNER NODE of the row (the reference's
+    # uncommitted_* sets live in the TxnManager context of the node that
+    # processed the access, txn.h:180-184 at each participant)
+    uw: dict = dataclasses.field(default_factory=dict)   # writers of my reads
+    ur: dict = dataclasses.field(default_factory=dict)   # readers of my writes
+    uwy: dict = dataclasses.field(default_factory=dict)  # writers of my writes
+    owner_lower: dict = dataclasses.field(default_factory=dict)
+    # per-owner verdicts: a node that validated a txn locally marks it
+    # VALIDATED in ITS TimeTable even when 2PC later aborts it elsewhere —
+    # later validators at that node see (and are pushed by) the local state
+    state_o: dict = dataclasses.field(default_factory=dict)
 
 
 class MaatManager(Manager):
     """MaaT (maat.cpp:29-190, row_maat.cpp:54-314), full reference
     structures: TimeTable ranges, per-row lr/lw + uncommitted sets, access-
     time set copies, the 5 validation cases, neighbor squeeze, and
-    commit-time forward validation."""
+    commit-time forward validation.
+
+    Distributed fidelity (node_cnt > 1): the reference keeps a TimeTable
+    PER NODE, synced only by the lower/upper ride-alongs in Ack/finish
+    messages — validation runs at each participant on its local view, and
+    a txn that validates ok at one node but fails 2PC elsewhere has still
+    applied its pushes (nothing retracts them).  This interpreter mirrors
+    that per-owner protocol the way the sharded engine realizes it: tick-
+    start bounds are the home-merged (global) values, each owner's
+    validators read tick-start bounds + their OWN owner's same-tick pushes
+    (a per-owner overlay), per-owner verdicts AND a merged-range check
+    decide the commit (Maat::find_bound at the coordinator), and overlays
+    merge back into the global table at tick end (the Ack ride-along).
+    node_cnt == 1 degenerates to a single always-current view."""
 
     needs_new_ts_on_restart = True
 
     def __init__(self, cfg, n_rows):
         super().__init__(cfg, n_rows)
+        self.P = max(cfg.part_cnt, 1)
         self.tt: dict[int, MaatTxn] = {}    # tid -> record (TimeTable; released at commit)
         self.lr: dict[int, int] = {}
         self.lw: dict[int, int] = {}
         self.u_reads: dict[int, set] = {}
         self.u_writes: dict[int, set] = {}
+        # owner -> tid -> [pushed lower, pushed upper] (this tick)
+        self.overlay = [dict() for _ in range(self.P)]
 
     def on_start(self, txn):
         # time_table.init on RTXN (worker_thread.cpp:504-508): restarts
         # re-init the SAME id; new queries get a fresh id
         self.tt[txn.tid] = MaatTxn()
+        for ov in self.overlay:
+            ov.pop(txn.tid, None)
+
+    def _rb(self, o, s):
+        """Bounds of txn s as owner o sees them this tick: tick-start
+        globals tightened by owner o's own pushes."""
+        m = self.tt.get(s)
+        if m is None:
+            return None
+        ov = self.overlay[o].get(s)
+        if ov is None:
+            return m.lower, m.upper
+        return max(m.lower, ov[0]), min(m.upper, ov[1])
+
+    def _push(self, o, s, lo=None, up=None):
+        ov = self.overlay[o].setdefault(s, [0, int(BIG)])
+        if lo is not None:
+            ov[0] = max(ov[0], lo)
+        if up is not None:
+            ov[1] = min(ov[1], up)
+
+    def flush_tick(self):
+        # tick-end merge: owner pushes ride home and re-ship next tick
+        for ov in self.overlay:
+            for s, (lo, up) in ov.items():
+                m = self.tt.get(s)
+                if m is not None:
+                    m.lower = max(m.lower, lo)
+                    m.upper = min(m.upper, up)
+            ov.clear()
 
     def access(self, txn, key, iw):
         m = self.tt[txn.tid]
+        o = key % self.P
         ur = self.u_reads.setdefault(key, set())
         uw = self.u_writes.setdefault(key, set())
         if iw:  # prewrite (row_maat.cpp:129-164)
-            m.ur |= {s for s in ur if s != txn.tid}
-            m.uwy |= {s for s in uw if s != txn.tid}
+            m.ur.setdefault(o, set()).update(
+                s for s in ur if s != txn.tid)
+            m.uwy.setdefault(o, set()).update(
+                s for s in uw if s != txn.tid)
             m.gr = max(m.gr, self.lr.get(key, 0))
             m.gw = max(m.gw, self.lw.get(key, 0))
             uw.add(txn.tid)
         else:   # read (row_maat.cpp:99-127)
-            m.uw |= {s for s in uw if s != txn.tid}
+            m.uw.setdefault(o, set()).update(
+                s for s in uw if s != txn.tid)
             m.gw = max(m.gw, self.lw.get(key, 0))
             ur.add(txn.tid)
         return "grant"
 
-    def validate(self, txn, tick):
-        # maat.cpp:29-174 verbatim case structure
-        m = self.tt[txn.tid]
-        lower, upper = m.lower, m.upper
+    def _st(self, o, s):
+        """Neighbor state as owner o's TimeTable records it."""
+        m = self.tt[s]
+        return m.state_o.get(o, m.state)
+
+    def _validate_at(self, o, txn, m):
+        """maat.cpp:29-174 verbatim case structure, at owner o's view."""
+        start = self._rb(o, txn.tid)
+        lower, upper = start
         after, before = set(), set()
         if lower <= m.gw:                                   # case 1
             lower = m.gw + 1
-        for s in m.uw:                                      # case 2
-            o = self.tt.get(s)
-            if o is None:
+        for s in m.uw.get(o, ()):                           # case 2
+            b = self._rb(o, s)
+            if b is None:
                 continue
-            if upper >= o.lower:
-                if o.state in ("VALIDATED", "COMMITTED"):
-                    upper = o.lower - 1 if o.lower > 0 else o.lower
-                elif o.state == "RUNNING":
+            if upper >= b[0]:
+                st = self._st(o, s)
+                if st in ("VALIDATED", "COMMITTED"):
+                    upper = b[0] - 1 if b[0] > 0 else b[0]
+                elif st == "RUNNING":
                     after.add(s)
         if lower <= m.gr:                                   # case 3
             lower = m.gr + 1
-        for s in m.ur:                                      # case 4
-            o = self.tt.get(s)
-            if o is None:
+        for s in m.ur.get(o, ()):                           # case 4
+            b = self._rb(o, s)
+            if b is None:
                 continue
-            if lower <= o.upper:
-                if o.state in ("VALIDATED", "COMMITTED"):
-                    lower = o.upper + 1 if o.upper < BIG else o.upper
-                elif o.state == "RUNNING":
+            if lower <= b[1]:
+                st = self._st(o, s)
+                if st in ("VALIDATED", "COMMITTED"):
+                    lower = b[1] + 1 if b[1] < BIG else b[1]
+                elif st == "RUNNING":
                     before.add(s)
-        for s in m.uwy:                                     # case 5
-            o = self.tt.get(s)
-            if o is None or o.state == "ABORTED":
+        for s in m.uwy.get(o, ()):                          # case 5
+            b = self._rb(o, s)
+            if b is None or self._st(o, s) == "ABORTED":
                 continue
-            if o.state in ("VALIDATED", "COMMITTED"):
-                if lower <= o.upper:
-                    lower = o.upper + 1 if o.upper < BIG else o.upper
-            elif o.state == "RUNNING":
+            st = self._st(o, s)
+            if st in ("VALIDATED", "COMMITTED"):
+                if lower <= b[1]:
+                    lower = b[1] + 1 if b[1] < BIG else b[1]
+            elif st == "RUNNING":
                 after.add(s)
         if lower >= upper:
-            m.state = "ABORTED"
-            m.lower, m.upper = lower, upper
-            return False
-        m.state = "VALIDATED"
+            return False, lower, upper
         # neighbor squeeze (maat.cpp:121-157)
         for s in before:
-            o = self.tt[s]
-            if o.upper > lower and o.upper < upper - 1:
-                lower = o.upper + 1
+            b = self._rb(o, s)
+            if b[1] > lower and b[1] < upper - 1:
+                lower = b[1] + 1
         for s in before:
-            o = self.tt[s]
-            if o.upper >= lower:
-                o.upper = lower - 1 if lower > 0 else lower
+            b = self._rb(o, s)
+            if b[1] >= lower:
+                self._push(o, s, up=lower - 1 if lower > 0 else lower)
         for s in after:
-            o = self.tt[s]
-            if o.upper != BIG and o.upper > lower + 2 and o.upper < upper:
-                upper = o.upper - 2
-            if lower + 1 < o.lower < upper:
-                upper = o.lower - 1
+            b = self._rb(o, s)
+            if b[1] != BIG and b[1] > lower + 2 and b[1] < upper:
+                upper = b[1] - 2
+            if lower + 1 < b[0] < upper:
+                upper = b[0] - 1
         for s in after:
-            o = self.tt[s]
-            if o.lower <= upper:
-                o.lower = upper + 1 if upper < BIG else upper
+            b = self._rb(o, s)
+            if b[0] <= upper:
+                self._push(o, s, lo=upper + 1 if upper < BIG else upper)
         assert lower < upper
-        m.lower, m.upper = lower, upper
+        return True, lower, upper
+
+    def validate(self, txn, tick):
+        m = self.tt[txn.tid]
+        owners = []
+        for r in range(txn.n_req):
+            o = int(txn.keys[r]) % self.P
+            if o not in owners:
+                owners.append(o)
+        ok_all = True
+        lo_m, up_m = m.lower, m.upper
+        m.owner_lower = {}
+        for o in owners:
+            ok_o, lo_o, up_o = self._validate_at(o, txn, m)
+            ok_all = ok_all and ok_o
+            m.state_o[o] = "VALIDATED" if ok_o else "ABORTED"
+            # the local TimeTable records the locally-validated bounds
+            # (set_lower/set_upper run on both paths, maat.cpp:158-163);
+            # later validators at this owner read them via the overlay
+            self._push(o, txn.tid, lo=lo_o, up=up_o)
+            if ok_o:
+                m.owner_lower[o] = lo_o
+            lo_m = max(lo_m, lo_o)
+            up_m = min(up_m, up_o)
+        # home merge of per-owner verdicts + ranges (Ack ride-alongs +
+        # Maat::find_bound at the coordinator)
+        m.lower, m.upper = lo_m, up_m
+        if not ok_all or lo_m >= up_m:
+            m.state = "ABORTED"
+            return False
+        m.state = "VALIDATED"
         return True
+
+    def commit_check(self, txn) -> bool:
+        m = self.tt.get(txn.tid)
+        return m is not None and m.lower < m.upper
 
     def commit(self, txn, tick):
         m = self.tt[txn.tid]
@@ -489,29 +612,33 @@ class MaatManager(Manager):
         cts = m.lower                       # find_bound (maat.cpp:176-190)
         for r in range(txn.n_req):
             k = int(txn.keys[r])
+            o = k % self.P
             if txn.is_write[r]:
                 # Row_maat::commit WR (row_maat.cpp:277-307)
                 self.lw[k] = max(self.lw.get(k, 0), cts)
                 self.u_writes.get(k, set()).discard(txn.tid)
                 for s in self.u_writes.get(k, set()):
-                    if s not in m.uwy:      # writers I never saw: before me
-                        o = self.tt.get(s)
-                        if o and o.upper >= cts:
-                            o.upper = cts - 1
+                    if s not in m.uwy.get(o, ()):  # writers I never saw
+                        b = self._rb(o, s)
+                        if b and b[1] >= cts:
+                            self._push(o, s, up=cts - 1)
+                # the reader-push reads the LOCAL TimeTable's lower
+                # (row_maat.cpp:283 get_lower at the owner)
+                loc_lo = m.owner_lower.get(o, cts)
                 for s in self.u_reads.get(k, set()):
-                    if s not in m.ur:       # readers I never saw: before me
-                        o = self.tt.get(s)
-                        if o and o.upper >= m.lower:
-                            o.upper = m.lower - 1
+                    if s not in m.ur.get(o, ()):   # readers I never saw
+                        b = self._rb(o, s)
+                        if b and b[1] >= loc_lo:
+                            self._push(o, s, up=loc_lo - 1)
             else:
                 # Row_maat::commit RD (row_maat.cpp:249-274)
                 self.lr[k] = max(self.lr.get(k, 0), cts)
                 self.u_reads.get(k, set()).discard(txn.tid)
                 for s in self.u_writes.get(k, set()):
-                    if s not in m.uw:       # writers I never saw: after me
-                        o = self.tt.get(s)
-                        if o and o.lower <= cts:
-                            o.lower = cts + 1
+                    if s not in m.uw.get(o, ()):   # writers I never saw
+                        b = self._rb(o, s)
+                        if b and b[0] <= cts:
+                            self._push(o, s, lo=cts + 1)
         # TimeTable::release (txn.cpp:431): stale lookups read defaults
         # (state ABORTED) and are ignored by later validators
         del self.tt[txn.tid]
@@ -564,6 +691,9 @@ class SequentialEngine:
         if pool is None:
             pool = workload.gen_pool(cfg)
         self.pool = pool
+        self.ua_flags = workload.pool_user_abort(cfg, pool)
+        self.recon_types = (workload.recon_types
+                            if cfg.cc_alg == "CALVIN" else ())
         n_rows = workload.cc_rows(cfg)
         self.man = make_manager(cfg, n_rows)
         B = cfg.batch_size
@@ -627,10 +757,18 @@ class SequentialEngine:
         # draws BEFORE a restarting slot 5 — interleaving the two loops
         # must match that order or redraw-family (T/O) priorities skew
         admitted = [0] * self.N
+        if calvin:
+            # resumed (recon-deferred) txns consume this epoch's batch
+            # slots too (the re-submitted txn joins a later batch,
+            # sequencer.cpp:88-114; engine: gate += sum(expire))
+            for txn in self.txns:
+                if txn.status == BACKOFF and txn.backoff_until <= t:
+                    admitted[txn.node] += 1
         for txn in self.txns:
             if txn.status == BACKOFF and txn.backoff_until <= t:
                 txn.status = RUNNING
                 txn.start_tick = t
+                txn.shadow = False
                 if redraw:
                     txn.ts = self._draw_ts(txn.node)
                 if delay:
@@ -643,6 +781,8 @@ class SequentialEngine:
                 txn.keys = self.pool.keys[q]
                 txn.is_write = self.pool.is_write[q]
                 txn.n_req = int(self.pool.n_req[q])
+                txn.ttype = int(self.pool.txn_type[q])
+                txn.rbk = bool(self.ua_flags[q])
                 txn.tid = self.next_tid
                 self.next_tid += 1
                 txn.cursor = 0
@@ -655,6 +795,13 @@ class SequentialEngine:
                 admitted[txn.node] += 1
                 self.stats["local_txn_start_cnt"] += 1
                 man.on_start(txn)
+                if calvin and txn.ttype in self.recon_types:
+                    # Calvin reconnaissance deferral (sequencer.cpp:
+                    # 88-114): sleep one tick; the shadow read pass runs
+                    # in this tick's access phase (engine recon_defer)
+                    txn.status = BACKOFF
+                    txn.backoff_until = t + 1
+                    txn.shadow = True
 
     def _tick(self):
         cfg, man, t = self.cfg, self.man, self.tick
@@ -676,8 +823,30 @@ class SequentialEngine:
         val_aborted = set()
 
         def commit_phase(finishing):
-            for txn in sorted(finishing, key=lambda x: x.ts):
-                if man.validate(txn, t):
+            # N>1: validation (2PC prepare, exchange A) and commit (RFIN,
+            # exchange B) are separate rounds — ALL validations run before
+            # ANY commit applies, so a later validator sees an earlier one
+            # as VALIDATED in the local TimeTable (not deleted), exactly
+            # like the reference's prepare/finish gap.  N=1 keeps the
+            # interleaved order (validate+commit per txn, in ts order).
+            ordered = []
+            for x in sorted(finishing, key=lambda y: y.ts):
+                if x.rbk:
+                    # workload rollback (TPC-C rbk, tpcc_txn.cpp:485-489):
+                    # releases CC state like an abort, frees the slot, no
+                    # retry, no abort-rate contribution (engine ua path)
+                    man.user_release(x)
+                    x.status = FREE
+                    self.stats["user_abort_cnt"] = self.stats.get(
+                        "user_abort_cnt", 0) + 1
+                else:
+                    ordered.append(x)
+            if self.N > 1:
+                verdicts = [(x, man.validate(x, t)) for x in ordered]
+            else:
+                verdicts = ((x, None) for x in ordered)
+            for txn, ok in verdicts:
+                if man.validate(txn, t) if ok is None else ok:
                     man.commit(txn, t)
                     for r in range(txn.n_req):
                         if txn.is_write[r]:
@@ -704,10 +873,20 @@ class SequentialEngine:
         # single-node replay releases inline (the worker thread frees its
         # own locks in-process).
         deferred_aborts = []
+        shadows = [x for x in self.txns
+                   if x.status == BACKOFF and x.shadow
+                   and x.backoff_until > t]
         active = [x for x in self.txns
                   if x.status in (RUNNING, WAITING)
                   and x.slot not in val_aborted and x.cursor < x.n_req]
-        for txn in sorted(active, key=lambda x: x.ts):
+        for txn in sorted(active + shadows, key=lambda x: x.ts):
+            if txn.shadow:
+                # Calvin recon shadow pass: the deferred txn requests its
+                # whole footprint READ-ONLY; decisions are discarded and
+                # the transient entries withdraw at tick end
+                for r in range(txn.n_req):
+                    man.access(txn, int(txn.keys[r]), False)
+                continue
             if cfg.cc_alg == "CALVIN":
                 # acquire_locks() requests EVERY remaining lock at the
                 # txn's sequencing turn, continuing past WAITs
@@ -738,6 +917,8 @@ class SequentialEngine:
                     else:
                         self._abort(txn)
                     break
+        for txn in shadows:
+            man.drop(txn)
 
         if self.N > 1:
             # sharded ordering: commit the txns that were finishing at tick
@@ -751,6 +932,7 @@ class SequentialEngine:
             # access granted (Config.commit_after_access)
             commit_phase(fresh_finishing())
 
+        man.flush_tick()
         self.tick += 1
 
     # -- net_delay mode (Config.net_delay_ticks > 0, N-node) --
@@ -784,11 +966,21 @@ class SequentialEngine:
         # 1-2. backoff expiry + admission (shared with _tick)
         self._expire_and_admit(t, delay=True)
 
-        # 3. finish-gate observation (start-of-tick cursors)
+        # 3. finish-gate observation (start-of-tick cursors).  Workload
+        # rollbacks (TPC-C rbk) leave here: no 2PC round, slot freed,
+        # CC released like an abort (the sharded engine's
+        # `finishing & ~ua` gate before entry shipping)
         validating = []
         for txn in self.txns:
             if txn.status == RUNNING and txn.cursor >= txn.n_req \
                     and txn.pend is None:
+                if txn.rbk:
+                    man.user_release(txn)
+                    txn.status = FREE
+                    txn.pend = txn.val = txn.fin_at = None
+                    self.stats["user_abort_cnt"] = self.stats.get(
+                        "user_abort_cnt", 0) + 1
+                    continue
                 if txn.fin_at is None:
                     txn.fin_at = t + (D if self._has_rem(txn) else 0)
                 if txn.fin_at <= t and txn.val is None:
@@ -862,7 +1054,7 @@ class SequentialEngine:
             ok, _ = txn.val
             txn.val = None
             txn.fin_at = None
-            if ok:
+            if ok and man.commit_check(txn):
                 man.commit(txn, t)
                 for r in range(txn.n_req):
                     if txn.is_write[r]:
@@ -875,6 +1067,7 @@ class SequentialEngine:
             else:
                 self._abort(txn)
 
+        self.man.flush_tick()
         self.tick += 1
 
     def _abort(self, txn):

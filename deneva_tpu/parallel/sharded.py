@@ -87,7 +87,9 @@ class ShardState(NamedTuple):
     #:   fin_ready   (B,)   tick the 2PC prepare may run (finish + transit)
     #:   vote_tick   (B,)   tick votes were gathered (BIG_TS: not yet)
     #:   vote_ok     (B,)   latched AND of owner votes + home check
-    net: dict = {}
+    #: (no default: a shared mutable {} default would alias one dict
+    #: across instances — construction must pass _init_net's product)
+    net: dict
 
 
 def _init_net(cfg: Config, B: int, R: int) -> dict:
@@ -99,7 +101,12 @@ def _init_net(cfg: Config, B: int, R: int) -> dict:
             "abort_due": big(B),
             "fin_ready": big(B),
             "vote_tick": big(B),
-            "vote_ok": jnp.zeros(B, dtype=bool)}
+            "vote_ok": jnp.zeros(B, dtype=bool),
+            # per-entry owner votes latched with the round: an owner that
+            # voted yes keeps the txn VALIDATED/prepared in ITS view even
+            # when another owner's no-vote dooms the txn (the abort
+            # releases it only at the RFIN round)
+            "vote_e": jnp.zeros((B, R), dtype=bool)}
 
 
 def _flags(iw, held, req, fin, prepared=None):
@@ -308,10 +315,11 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
             "ts": ts_e,
             "flags": _flags(
                 ent.is_write, held, req, fin2.reshape(-1),
-                prepared=(((net["vote_tick"] < BIG_TS)
-                           & net["vote_ok"])[:, None]
+                prepared=((net["vote_tick"] < BIG_TS)[:, None]
+                          & net["vote_e"]
                           & (ridx < txn.n_req[:, None])).reshape(-1)
-                if dly and plugin.release_on_vabort else None),
+                if dly and (plugin.release_on_vabort
+                            or plugin.commit_forward_push) else None),
             "start_tick": stick.reshape(-1),
         }
         for f in plugin.txn_db_fields:
@@ -374,7 +382,15 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
         vactive = o_live
         if normal:
             dec, vdb = plugin.access(cfg, vdb, vtxn, vactive)
-            votes, vdb = plugin.validate(cfg, vdb, vtxn, o_fin, t)
+            vkw = {}
+            if dly and plugin.commit_forward_push:
+                # validated-but-uncommitted entries (2PC prepare window)
+                # are a distinct class at the owner: VALIDATED in its
+                # TimeTable — they push new validators via cases 2/4/5
+                # and stop being squeeze targets (cc/maat.py)
+                vkw["prepared"] = (((o_flags >> 4) & 1 == 1) & o_live
+                                   & ~o_fin)
+            votes, vdb = plugin.validate(cfg, vdb, vtxn, o_fin, t, **vkw)
         else:
             # NOCC ladder: every request grants at its owner, every vote
             # is yes (row.cpp:199-206)
@@ -431,9 +447,11 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
         else:
             grant_vis = grant
 
+        per_entry_db = {}
         for f in plugin.txn_db_fields:
             per_e = jnp.where(local_e, vdb_loc[f],
                               got[f][:nE]).reshape(B, R)
+            per_entry_db[f] = per_e
             if plugin.txn_db_merge[f] == "max":
                 db = {**db, f: jnp.maximum(db[f], per_e.max(axis=1))}
             else:
@@ -455,6 +473,8 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
                                                 do_latch & votes_ok)
             net["vote_tick"] = jnp.where(do_latch, t, net["vote_tick"])
             net["vote_ok"] = jnp.where(do_latch, latch_ok, net["vote_ok"])
+            net["vote_e"] = jnp.where(do_latch[:, None], vote_e,
+                                      net["vote_e"])
             commit_due = finishing & (net["vote_tick"] < BIG_TS) \
                 & (t >= net["vote_tick"] + vote_delay) & ~ovf_txn
             commit_try = commit_due & net["vote_ok"]
@@ -553,6 +573,16 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
             "cts": cts_e,
             "iw": txn.is_write.reshape(-1).astype(jnp.int32),
         }
+        if normal and plugin.commit_forward_push:
+            # the commit-time forward validation (RFIN processing) needs
+            # the committer's per-row access order and its OWNER-validated
+            # lower (the local TimeTable value the reference's reader-push
+            # reads, row_maat.cpp:283) — the latter came home per entry on
+            # exchange A'
+            fieldsB["atick"] = fields["start_tick"]
+            fieldsB["fts"] = ts_e
+            fieldsB["loclo"] = per_entry_db[
+                plugin.commit_ts_field].reshape(-1)
         sendB, origB, ovfB = routing.pack_by_dest(
             dest, ts_e, commit_e & ~local_e, n_nodes, cap, fieldsB)
         ovfB_txn = jnp.any(ovfB.reshape(B, R), axis=1)
@@ -640,6 +670,39 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
         db = {**db, **{k: v for k, v in vdbB.items()
                        if k not in plugin.txn_db_fields
                        and k != plugin.commit_ts_field}}
+        if normal and plugin.commit_forward_push:
+            # commit-time forward validation (RFIN at the owner,
+            # row_maat.cpp:208-307): globally-committed entries push the
+            # live row members that never saw them.  The live view is the
+            # A-phase owner lanes (held + granted-this-tick); the pushed
+            # bounds ride home on a third exchange leg reusing the
+            # A-phase pack permutation.
+            rB_atick = owner_cat(recvB["atick"], fieldsB["atick"])
+            rB_fts = owner_cat(recvB["fts"], fieldsB["fts"])
+            rB_loclo = owner_cat(recvB["loclo"], fieldsB["loclo"])
+            fresh_g = dec.grant.reshape(-1) & ~o_held & o_live
+            lo_push, up_push = plugin.commit_forward_entries(
+                cfg,
+                {"key": rB_key, "cts": rB_cts, "iw": rB_iw,
+                 "atick": rB_atick, "ts": rB_fts, "loclo": rB_loclo,
+                 "commit": rB_commit},
+                {"key": o_key, "iw": o_iw, "atick": o_stick, "ts": o_ts,
+                 "live": o_held | fresh_g})
+            backC = {"lo": lo_push[:nR].reshape(n_nodes, cap),
+                     "up": up_push[:nR].reshape(n_nodes, cap)}
+            retC = routing.exchange(backC, AXIS)
+            gotC = routing.unpack(
+                retC, orig, nE,
+                {"lo": jnp.zeros(nE + 1, jnp.int32),
+                 "up": jnp.full(nE + 1, BIG_TS, jnp.int32)})
+            lo_home = jnp.where(local_e, lo_push[nR:],
+                                gotC["lo"][:nE]).reshape(B, R)
+            up_home = jnp.where(local_e, up_push[nR:],
+                                gotC["up"][:nE]).reshape(B, R)
+            flo, fup = plugin.forward_push_fields
+            db = {**db,
+                  flo: jnp.maximum(db[flo], lo_home.max(axis=1)),
+                  fup: jnp.minimum(db[fup], up_home.min(axis=1))}
         if apply_writes:
             data = data.at[jnp.where(rB_commit & rB_iw, rB_key,
                                      NULL_KEY)].add(1, mode="drop")

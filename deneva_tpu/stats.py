@@ -86,14 +86,18 @@ def reference_summary(s: dict, wall_seconds: float | None = None) -> dict:
         "cc_vabort_cnt": s.get("vabort_cnt", 0),
         "user_abort_cnt": s.get("user_abort_cnt", 0),
     }
-    # per-algorithm case/outcome families (statistics/stats.h:
-    # maat_case1-6, occ check aborts; maat.cpp:46-111) — emitted only
-    # when the run's CC algorithm produced them
-    for k in ("maat_case1_cnt", "maat_case2_cnt", "maat_case3_cnt",
-              "maat_case4_cnt", "maat_case6_cnt", "occ_hist_abort_cnt",
+    # per-algorithm case/outcome families — emitted only when the run's
+    # CC algorithm produced them, with keys VERBATIM (the reference
+    # prints maat_caseN_cnt=%ld, stats.cpp:907).  maat_case1/3 are the
+    # reference families (maat.cpp:46-48,68-70); the maat_chain_*/
+    # maat_range_abort/occ_*/mvcc_* names are this build's inventions
+    # (cc/maat.py init_db documents the mapping).
+    for k in ("maat_case1_cnt", "maat_case3_cnt", "maat_chain_cap_cnt",
+              "maat_chain_push_cnt", "maat_range_abort_cnt",
+              "maat_chain_overflow_cnt", "occ_hist_abort_cnt",
               "occ_active_abort_cnt", "mvcc_tail_fold_cnt"):
         if k in s:
-            out[k.replace("_cnt", "")] = s[k]
+            out[k] = s[k]
     if "ccl_samples" in s:
         ccl = latency_percentiles(s["ccl_samples"], s.get("ccl_valid", 0))
         out.update({k: v * tick_sec for k, v in ccl.items()})

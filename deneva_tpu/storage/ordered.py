@@ -63,19 +63,21 @@ class OrderedIndex:
         index_btree.cpp:118-168, as one static-width window); entries past
         hi (exclusive, optional) or past the key column pad to NULL_ROW.
 
-        lo may be a scalar or a (Q,) batch; result gains a leading Q axis.
+        lo and hi may each be a scalar or a (Q,) batch (broadcast
+        together); a batched call gains a leading Q axis.
         """
         lo = jnp.asarray(lo, jnp.int32)
+        if hi is not None:
+            lo, hi = jnp.broadcast_arrays(lo, jnp.asarray(hi, jnp.int32))
         start = jnp.searchsorted(self.keys, lo).astype(jnp.int32)
         offs = jnp.arange(W, dtype=jnp.int32)
-        pos = start[..., None] + offs if start.ndim else start + offs
+        pos = start[..., None] + offs
+        if not start.ndim:
+            pos = pos.reshape(W)
         valid = pos < self.n
         pc = jnp.clip(pos, 0, self.n - 1)
         if hi is not None:
-            valid = valid & (self.keys[pc]
-                             < jnp.asarray(hi, jnp.int32)[..., None]
-                             if start.ndim else
-                             self.keys[pc] < jnp.asarray(hi, jnp.int32))
+            valid = valid & (self.keys[pc] < hi[..., None])
         return jnp.where(valid, pos, NULL_ROW)
 
     def range_count(self, lo, hi):

@@ -115,3 +115,8 @@ class WorkloadPlugin:
         retrying a deterministic rollback would livelock)."""
         import jax.numpy as jnp
         return jnp.zeros_like(finishing)
+
+    def pool_user_abort(self, cfg, pool: QueryPool) -> np.ndarray:
+        """(Q,) bool per pool row: user_abort's decision precomputed for
+        the sequential oracle (it is pool-static for every workload)."""
+        return np.zeros(pool.size, bool)

@@ -638,4 +638,8 @@ class TPCCWorkload(WorkloadPlugin):
     def user_abort(self, cfg: Config, txn, finishing):
         return finishing & (txn.targs[:, TA_RBK] == 1)
 
+    def pool_user_abort(self, cfg: Config, pool):
+        import numpy as np
+        return np.asarray(pool.args[:, TA_RBK] == 1)
+
     # invariant checks live in tests/test_tpcc.py::check_conservation

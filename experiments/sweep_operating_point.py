@@ -17,14 +17,17 @@ import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-from bench import run_cell  # noqa: E402
+from bench import YCSB_KW, run_cell  # noqa: E402
+from deneva_tpu.config import Config  # noqa: E402
 
 
 def cell(window, B, cap):
-    tput, s = run_cell(acquire_window=window, batch_size=B, admit_cap=cap,
-                       n_ticks=200, with_summary=True)
+    cfg = Config(cc_alg="NO_WAIT",
+                 **{**YCSB_KW, "batch_size": B, "admit_cap": cap,
+                    "acquire_window": window})
+    tput, cpt = run_cell(cfg, n_ticks=200)
     print(f"win={window} B={B:>6} cap={cap!s:>5}: {tput/1e3:8.1f} k/s  "
-          f"abort={s['abort_rate']:.3f}", flush=True)
+          f"commits/tick={cpt:7.1f}", flush=True)
     return tput
 
 

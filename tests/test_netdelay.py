@@ -78,11 +78,13 @@ def test_network_time_integral():
 
 DELAY_PARITY_THRESH = {
     # measured at D in {1,3}: NO_WAIT/WAIT_DIE/MVCC/CALVIN exact,
-    # TIMESTAMP 0.25%, OCC 0.12% (x~2 noise headroom).  MAAT ~3-4%: the
-    # engine approximates VALIDATED-state neighbors as squeezable running
-    # txns during the vote transit (documented in PARITY.md).
+    # TIMESTAMP 0.25%, OCC 0.12% (x~2 noise headroom).  MAAT measured
+    # -1.1..-2.6% over seeds (round 5, was 3-4.5%): prepared neighbors
+    # now push via cases 2/4/5 and commit-time forward validation runs
+    # at the commit exchange; the residual is cross-owner same-tick push
+    # invisibility during the transit window (PARITY.md).
     "NO_WAIT": 0.005, "WAIT_DIE": 0.005, "TIMESTAMP": 0.01, "MVCC": 0.005,
-    "OCC": 0.01, "MAAT": 0.055, "CALVIN": 0.005,
+    "OCC": 0.01, "MAAT": 0.035, "CALVIN": 0.005,
 }
 
 
@@ -91,11 +93,12 @@ def test_delay_parity_vs_oracle(alg):
     """The sequential oracle replays the delayed tick protocol; abort-rate
     divergence at D=1 must stay at (near-)exact levels — the delay model
     is part of the CC semantics, not a perf knob."""
-    from deneva_tpu.oracle.parity import run_pair_sharded
+    from deneva_tpu.oracle.parity import PARITY_EXTRA, run_pair_sharded
+    extra = PARITY_EXTRA.get(alg, {})
     cfg = Config(cc_alg=alg, node_cnt=2, part_cnt=2, batch_size=64,
                  synth_table_size=1 << 14, req_per_query=6, zipf_theta=0.6,
                  query_pool_size=1 << 12, mpr=1.0, part_per_txn=2,
-                 warmup_ticks=0, net_delay_ticks=1)
+                 warmup_ticks=0, net_delay_ticks=1, **extra)
     r = run_pair_sharded(cfg, 40)
     assert r["batched_conserved"] and r["sequential_conserved"], r
     assert r["abort_rate_divergence"] <= DELAY_PARITY_THRESH[alg], r

@@ -98,24 +98,28 @@ def test_prog_line_tag():
 
 
 def test_cc_case_counter_families():
-    """The per-algorithm stats.h families (maat_case1-6, occ check aborts)
-    ride the [summary] line and round-trip through the parser port."""
+    """The per-algorithm families (reference maat_case1/3 + this build's
+    chain counters, occ check aborts) ride the [summary] line VERBATIM
+    (the reference prints maat_caseN_cnt=%ld, stats.cpp:907) and
+    round-trip through the parser port."""
     eng, st = run_engine(cc_alg="MAAT")
     line = eng.summary_line(st, wall_seconds=1.0)
     parsed = stats_mod.parse_summary(line)
-    for k in ("maat_case1", "maat_case2", "maat_case3", "maat_case4",
-              "maat_case6"):
+    for k in ("maat_case1_cnt", "maat_case3_cnt", "maat_chain_cap_cnt",
+              "maat_chain_push_cnt", "maat_range_abort_cnt",
+              "maat_chain_overflow_cnt"):
         assert k in parsed, k
     # contention at zipf 0.8 must actually exercise the case machinery
-    assert parsed["maat_case1"] > 0
-    assert parsed["maat_case6"] >= 0
+    assert parsed["maat_case1_cnt"] > 0
+    assert parsed["maat_range_abort_cnt"] >= 0
 
     eng, st = run_engine(cc_alg="OCC")
     parsed = stats_mod.parse_summary(eng.summary_line(st, wall_seconds=1.0))
-    assert "occ_hist_abort" in parsed and "occ_active_abort" in parsed
+    assert "occ_hist_abort_cnt" in parsed \
+        and "occ_active_abort_cnt" in parsed
     s = eng.summary(st)
     # every validation abort is classified into exactly one family
-    assert parsed["occ_hist_abort"] + parsed["occ_active_abort"] \
+    assert parsed["occ_hist_abort_cnt"] + parsed["occ_active_abort_cnt"] \
         == s["vabort_cnt"]
 
 
