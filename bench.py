@@ -18,9 +18,17 @@ Prints ONE JSON line {"metric", "value", "unit", "vs_baseline", ...}.
 vs_baseline scales the faithful number against the north star's per-chip
 share: BASELINE.md targets >=1M txns/s on a v5e-8 (8 chips), i.e. 125k/s
 per chip; this bench runs a single chip.
+
+With ``--trace`` / ``--profile`` / ``--prog-interval`` the script instead
+runs ONE small observed YCSB cell through the obs subsystem (deneva_tpu/obs):
+[prog] heartbeats, a Perfetto-loadable Chrome trace, a phase-profile and a
+structured run record under --out-dir, plus a trace-vs-summary
+reconciliation check.  EXPERIMENTS.md documents the CPU smoke invocation.
 """
 
+import argparse
 import json
+import os
 import time
 
 import jax
@@ -28,6 +36,8 @@ import numpy as np
 
 from deneva_tpu.config import Config
 from deneva_tpu.engine.scheduler import Engine
+from deneva_tpu.obs import profiler as obs_profiler
+from deneva_tpu.obs import trace as obs_trace
 
 NORTH_STAR_CLUSTER = 1_000_000   # committed txns/s on a v5e-8 (BASELINE.md)
 NORTH_STAR_CHIPS = 8
@@ -82,6 +92,65 @@ def run_cell(cfg: Config, n_ticks: int = 300, windows: int = 7):
     return float(np.median(tputs)), float(np.median(cpt))
 
 
+# small, CPU-friendly observed cell (the EXPERIMENTS.md smoke shape):
+# contended enough that aborts/waits show up on the timeline
+OBS_KW = dict(
+    batch_size=256, synth_table_size=1 << 12, req_per_query=4,
+    zipf_theta=0.8, tup_read_perc=0.5, query_pool_size=1 << 12,
+    warmup_ticks=0, admit_cap=64,
+)
+
+
+def run_obs(args) -> int:
+    """Observed run: trace + [prog] + phase profile on a small YCSB cell.
+    Returns a process exit code (non-zero when reconciliation fails)."""
+    cfg = Config(
+        cc_alg=args.cc_alg,
+        trace_ticks=(args.trace_ticks or args.ticks) if args.trace else 0,
+        prog_interval=args.prog_interval,
+        profile=args.profile,
+        **OBS_KW)
+    eng = Engine(cfg)
+    t0 = time.perf_counter()
+    state = eng.run(args.ticks)
+    wall = time.perf_counter() - t0
+    summary = eng.summary(state, wall)
+    print(eng.summary_line(state, wall))
+
+    code = 0
+    artifacts = {}
+    if args.trace:
+        tr_path = f"{args.out_dir}/trace_{cfg.cc_alg.lower()}.json"
+        os.makedirs(args.out_dir, exist_ok=True)
+        obs_trace.to_chrome_trace(state, tr_path, n_ticks=args.ticks)
+        artifacts["chrome_trace"] = tr_path
+        # reconciliation: ring column sums == whole-run [summary] counters
+        # (exact: warmup_ticks=0 and the ring accumulates on wrap)
+        tot = obs_trace.totals(state)
+        checks = {"commit": ("txn_cnt", tot["commit"]),
+                  "abort": ("total_txn_abort_cnt", tot["abort"]),
+                  "admit": ("local_txn_start_cnt", tot["admit"]),
+                  "lock_wait": ("twopl_wait_cnt", tot["lock_wait"])}
+        for col, (key, got) in checks.items():
+            want = summary[key]
+            ok = got == want
+            print(f"[reconcile] trace.{col}={got} summary.{key}={want} "
+                  f"{'OK' if ok else 'MISMATCH'}")
+            if not ok:
+                code = 1
+    if args.profile or args.trace:
+        rec = obs_profiler.run_record(
+            cfg, summary,
+            phases=eng.profiler.snapshot() if eng.profiler else None,
+            timeline=(obs_trace.timeline(state) if args.trace else None),
+            extra={"wall_seconds": wall, "artifacts": artifacts})
+        rec_path = obs_profiler.write_run_record(rec, out_dir=args.out_dir)
+        print(f"[obs] run record: {rec_path}")
+    if eng.profiler is not None:
+        print(f"[obs] phases: {json.dumps(eng.profiler.snapshot())}")
+    return code
+
+
 def main():
     per_chip_star = NORTH_STAR_CLUSTER / NORTH_STAR_CHIPS
     faithful, _ = run_cell(Config(cc_alg="NO_WAIT", acquire_window=1,
@@ -114,5 +183,30 @@ def main():
     }))
 
 
+def _cli():
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--trace", action="store_true",
+                   help="record the per-tick timeline and export a "
+                        "Perfetto-loadable Chrome trace JSON")
+    p.add_argument("--trace-ticks", type=int, default=0,
+                   help="trace ring depth (default: --ticks, so every "
+                        "tick gets its own row)")
+    p.add_argument("--profile", action="store_true",
+                   help="host-side phase profiling (compile vs dispatch "
+                        "vs execute + jit recompile count)")
+    p.add_argument("--prog-interval", type=int, default=0,
+                   help="emit a [prog] heartbeat line every N ticks")
+    p.add_argument("--ticks", type=int, default=200,
+                   help="ticks for the observed run (default 200)")
+    p.add_argument("--cc-alg", default="NO_WAIT",
+                   help="CC algorithm for the observed run")
+    p.add_argument("--out-dir", default="results",
+                   help="directory for trace JSON + run record")
+    return p.parse_args()
+
+
 if __name__ == "__main__":
+    _args = _cli()
+    if _args.trace or _args.profile or _args.prog_interval:
+        raise SystemExit(run_obs(_args))
     main()

@@ -279,6 +279,12 @@ class Maat(CCPlugin):
         # my (key, txn)-run start: same txn's entries on one key share ts
         run_start3 = st3 | (t3 != jnp.roll(t3, 1))
         M = max(int(cfg.maat_chain_window), 1)
+        # jnp.roll wraps: lane i < d would pair with lane n-d+i (the
+        # ARRAY's tail, not a chain predecessor) whenever one key's run
+        # spans the whole array — degenerate single-key workloads hit
+        # this.  The key-equality guard normally breaks cross-key wraps
+        # but not same-key ones; mask the wrapped lanes explicitly.
+        lane = jnp.arange(n, dtype=jnp.int32)
 
         # The pair window's STATIC classification is bit-packed — 2 bits
         # per distance d — into one int32 lane array: 0 = no pair,
@@ -290,7 +296,7 @@ class Maat(CCPlugin):
         # and the per-step unpack is a free elementwise shift.
         wcode = jnp.zeros(n, jnp.int32)
         for d in range(1, min(M, 16)):
-            pair_s = (fin3 & iw3 & jnp.roll(fin3, d)
+            pair_s = (fin3 & iw3 & jnp.roll(fin3, d) & (lane >= d)
                       & (jnp.roll(k3, d) == k3)
                       & (jnp.roll(t3, d) != t3))
             conc_s = jnp.roll(at3, d) <= at3
@@ -304,7 +310,7 @@ class Maat(CCPlugin):
         # carry size for exactness)
         far = []
         for d in range(16, M):
-            pair_s = (fin3 & iw3 & jnp.roll(fin3, d)
+            pair_s = (fin3 & iw3 & jnp.roll(fin3, d) & (lane >= d)
                       & (jnp.roll(k3, d) == k3)
                       & (jnp.roll(t3, d) != t3))
             conc_s = jnp.roll(at3, d) <= at3
@@ -369,7 +375,7 @@ class Maat(CCPlugin):
                     cls = (wcode >> (2 * (d - 1))) & 3
                 else:
                     cls = far[d - 16].astype(jnp.int32)
-                cls = jnp.where(jnp.roll(okf, d), cls, 0)
+                cls = jnp.where(jnp.roll(okf, d) & (lane >= d), cls, 0)
                 p_lo = jnp.roll(s_lo, d)
                 p_up = jnp.roll(s_up, d)
                 c1 = jnp.where((s_up < BIG_TS) & (s_up > p_lo + 2)
@@ -461,8 +467,11 @@ class Maat(CCPlugin):
         cnt = lambda m: jnp.where(measuring,
                                   jnp.sum((m & rep).astype(jnp.int32)), 0)
         # row-ticks whose validator count exceeds the pair window (their
-        # farthest writer-target pairs were dropped)
-        nfin_seg = seg.seg_reduce(fin3.astype(jnp.int32), st3, "sum")
+        # farthest writer-target pairs were dropped).  Count distinct
+        # VALIDATORS (one (key, txn) run each, run_start3) — a txn with
+        # several finishing entries on one row is still one validator
+        nfin_seg = seg.seg_reduce((run_start3 & fin3).astype(jnp.int32),
+                                  st3, "sum")
         ovf = jnp.where(measuring & (M < B),
                         jnp.sum((st3 & (nfin_seg > M)).astype(jnp.int32)),
                         0)

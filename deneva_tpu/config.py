@@ -247,7 +247,23 @@ class Config:
     #: trace_ticks ticks, and the commit-latency ring also records start
     #: ticks so recent txn lifetimes can be drawn
     #: (experiments/timeline_plot.py).  0 = off (no trace arrays carried).
+    #: The buffer wraps (tick % trace_ticks) and ACCUMULATES, so column
+    #: sums always equal whole-run totals; size it >= the run length for
+    #: per-tick plots (deneva_tpu/obs/trace.py).
     trace_ticks: int = 0
+
+    #: emit a ``[prog]`` heartbeat line every this-many ticks during
+    #: Engine.run / ShardedEngine.run (the PROG_TIMER dump,
+    #: system/thread.cpp:86-105; deneva_tpu/obs/prog.py).  Each emission
+    #: syncs the device.  0 = off.
+    prog_interval: int = 0
+
+    #: host-side phase profiling (deneva_tpu/obs/profiler.py): time
+    #: trace/lower/compile vs dispatch vs execute around every engine
+    #: dispatch and count jit recompiles.  Blocks after each dispatch
+    #: (forfeits host/device pipelining) but adds zero device work; read
+    #: the result from ``engine.profiler.snapshot()``.
+    profile: bool = False
 
     # --- run protocol (reference config.h:349-350: 60s warmup + 60s run) ---
     seed: int = 12345

@@ -32,6 +32,9 @@ import numpy as np
 from deneva_tpu import cc as cc_registry
 from deneva_tpu import workloads as wl_registry
 from deneva_tpu.config import Config
+from deneva_tpu.obs import trace as obs_trace
+from deneva_tpu.obs.prog import ProgressEmitter
+from deneva_tpu.obs.profiler import PhaseProfiler
 from deneva_tpu.engine.state import (
     NULL_KEY, STATUS_BACKOFF, STATUS_FREE, STATUS_RUNNING, STATUS_WAITING,
     TxnState,
@@ -99,12 +102,9 @@ def _zeros_stats(cfg: Config | None = None,
         B, R = wr_ring_shape
         s["arr_wr_ring"] = jnp.full((4 * B, R), NULL_ROW, jnp.int32)
         s["wr_ring_cursor"] = jnp.zeros((), jnp.int32)
-    if cfg is not None and cfg.trace_ticks > 0:
-        # per-tick event series (DEBUG_TIMELINE analog, scripts/timeline.py)
-        for k in ("arr_trace_admit", "arr_trace_commit", "arr_trace_abort",
-                  "arr_trace_waiting"):
-            s[k] = jnp.zeros(cfg.trace_ticks, jnp.int32)
-        s["arr_lat_start"] = jnp.zeros(LAT_SAMPLES, jnp.int32)
+    if cfg is not None:
+        # per-tick timeline ring (obs/trace.py); {} when trace_ticks == 0
+        s.update(obs_trace.init_trace(cfg, LAT_SAMPLES))
     if cfg is not None and cfg.logging:
         # command-log ring (Logger's log_file ring, system/logger.cpp:60-117:
         # one L_UPDATE record per committed write: lsn/txn_id/key)
@@ -187,16 +187,6 @@ def pool_admit(pool_dev: dict, txn: TxnState, admit, frank, pool_cursor,
     return keys, is_write, n_req, txn_type, targs, aux, pool_idx
 
 
-def trace_add(stats: dict, key: str, t, amount) -> dict:
-    """Record a per-tick event count into the trace series (present only
-    when Config.trace_ticks > 0; ticks past the depth are dropped)."""
-    if key not in stats:
-        return stats
-    T = stats[key].shape[0]
-    idx = jnp.where(t < T, t, T)
-    return {**stats, key: stats[key].at[idx].add(amount, mode="drop")}
-
-
 def bump(stats: dict, key: str, amount, measuring) -> dict:
     """Warmup-gated counter increment (INC_STATS + is_warmup_done,
     system/helper.h:136-150)."""
@@ -272,19 +262,6 @@ def track_state_latencies(stats: dict, txn: TxnState, measuring) -> dict:
         stats = bump(stats, key,
                      jnp.sum((txn.status == st_v).astype(jnp.int32)),
                      measuring)
-    return stats
-
-
-def trace_tick_events(stats: dict, t, n_admit, n_commit, n_abort,
-                      txn: TxnState) -> dict:
-    """Per-tick timeline series (DEBUG_TIMELINE analog): no-ops unless the
-    trace arrays exist."""
-    stats = trace_add(stats, "arr_trace_admit", t, n_admit)
-    stats = trace_add(stats, "arr_trace_commit", t, n_commit)
-    stats = trace_add(stats, "arr_trace_abort", t, n_abort)
-    stats = trace_add(
-        stats, "arr_trace_waiting", t,
-        jnp.sum((txn.status == STATUS_WAITING).astype(jnp.int32)))
     return stats
 
 
@@ -557,7 +534,7 @@ def make_tick(cfg: Config, plugin, pool_dev: dict, workload=None):
             txn = txn._replace(status=status, cursor=cursor,
                                backoff_until=backoff_until,
                                restarts=restarts2)
-            return txn, db, stats, abort_now
+            return txn, db, stats, abort_now, wait
 
         def _penalty(restarts):
             shift = jnp.minimum(restarts, 16)
@@ -570,13 +547,14 @@ def make_tick(cfg: Config, plugin, pool_dev: dict, workload=None):
         if not cfg.commit_after_access:
             txn, db, data, tables, stats, commit, vabort, ua = commit_block(
                 txn, db, data, tables, stats)
-            txn, db, stats, abort_now = access_block(txn, db, stats, vabort)
+            txn, db, stats, abort_now, wait = access_block(txn, db, stats,
+                                                           vabort)
             abort_total = abort_now          # includes vabort
             db = plugin.on_abort(cfg, db, txn, abort_now | ua) if normal \
                 else db
         else:
             z = jnp.zeros(txn.B, dtype=bool)
-            txn, db, stats, abort_now = access_block(txn, db, stats, z)
+            txn, db, stats, abort_now, wait = access_block(txn, db, stats, z)
             txn, db, data, tables, stats, commit, vabort, ua = commit_block(
                 txn, db, data, tables, stats)
             abort_total = abort_now | vabort
@@ -597,9 +575,14 @@ def make_tick(cfg: Config, plugin, pool_dev: dict, workload=None):
         # latency decomposition integrals: txn-ticks per end-of-tick state
         stats = track_state_latencies(stats, txn, measuring)
         if cfg.trace_ticks > 0:
-            stats = trace_tick_events(
-                stats, t, n_free, jnp.sum(commit.astype(jnp.int32)),
-                jnp.sum(abort_total.astype(jnp.int32)), txn)
+            stats = obs_trace.record_tick(
+                stats, t, txn.status,
+                admit=n_free,
+                commit=jnp.sum(commit.astype(jnp.int32)),
+                abort=jnp.sum(abort_total.astype(jnp.int32)),
+                vabort=jnp.sum(vabort.astype(jnp.int32)),
+                user_abort=jnp.sum(ua.astype(jnp.int32)),
+                lock_wait=jnp.sum(wait.astype(jnp.int32)))
 
         # ts wraparound guard: only relative order matters, and every live
         # txn's ts lies within [ts_counter - horizon, ts_counter], so rebase
@@ -669,6 +652,10 @@ class Engine:
         self._tick_fn = make_tick(cfg, self.plugin, self.pool_dev,
                                   self.workload)
         self._tick_jit = jax.jit(self._tick_fn, donate_argnums=0)
+        # host-side phase profiler (obs/profiler.py); None when disabled so
+        # the steady-state dispatch path stays non-blocking
+        self.profiler = PhaseProfiler() if cfg.profile else None
+        self._compiled_scans: set[int] = set()  # n_ticks already compiled
 
     def init_state(self) -> EngineState:
         from deneva_tpu.config import MODE_NOCC, MODE_NORMAL
@@ -690,13 +677,18 @@ class Engine:
             prog_every: int | None = None) -> EngineState:
         """Host-stepped run; prog_every prints the reference's ``[prog]``
         heartbeat line every that-many ticks (Thread::progress_stats,
-        system/thread.cpp:86-105)."""
+        system/thread.cpp:86-105; defaults to Config.prog_interval)."""
         if state is None:
             state = self.init_state()
+        if prog_every is None:
+            prog_every = self.cfg.prog_interval
+        prog = ProgressEmitter(self, prog_every)
         for i in range(n_ticks):
-            state = self._tick_jit(state)
-            if prog_every and (i + 1) % prog_every == 0:
-                print(self.summary_line(state, prog=True), flush=True)
+            if self.profiler is not None:
+                state = self.profiler.dispatch(self._tick_jit, state)
+            else:
+                state = self._tick_jit(state)
+            prog.maybe_emit(state, i + 1)
         return self._flush_writes(state)
 
     @functools.partial(jax.jit, static_argnums=(0, 1), donate_argnums=2)
@@ -725,7 +717,21 @@ class Engine:
         """Fully device-side run: n_ticks in one lax.fori_loop under jit."""
         if state is None:
             state = self.init_state()
-        return self._run_scan(n_ticks, state)
+        if self.profiler is None:
+            return self._run_scan(n_ticks, state)
+        # _run_scan is a bound-method jit (cache introspection sees self's
+        # descriptor, not the shared cache), so attribute compile time by
+        # whether this n_ticks has been scanned on this engine before
+        first = n_ticks not in self._compiled_scans
+        self._compiled_scans.add(n_ticks)
+        phase = "trace_lower_compile" if first else "dispatch"
+        if first:
+            self.profiler.count("jit_recompiles")
+        with self.profiler.phase(phase):
+            out = self._run_scan(n_ticks, state)
+        with self.profiler.phase("execute"):
+            jax.block_until_ready(out)
+        return out
 
     def summary(self, state: EngineState, wall_seconds: float | None = None) -> dict:
         """Host-side stats in the reference's [summary] vocabulary

@@ -23,6 +23,10 @@ STATUS_RUNNING = 1   # executing its access program
 STATUS_WAITING = 2   # current access blocked; retries each tick (WAIT rc)
 STATUS_BACKOFF = 3   # aborted, sleeping out its abort penalty
 
+#: index -> name, for trace exports and debug printing (obs/trace.py
+#: occupancy columns follow this order)
+STATUS_NAMES = ("FREE", "RUNNING", "WAITING", "BACKOFF")
+
 BIG_TS = np.int32(2**31 - 1)
 NULL_KEY = np.int32(2**31 - 1)  # sort sentinel: dead entries sort last
 

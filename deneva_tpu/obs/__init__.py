@@ -1,0 +1,19 @@
+"""Observability subsystem — the rebuild of the reference's measurement
+stack (statistics/stats.cpp + PROG_TIMER + DEBUG_TIMELINE printfs).
+
+Three pillars, all opt-in through ``Config`` so the disabled path adds
+zero device work:
+
+- :mod:`deneva_tpu.obs.trace`     device-resident per-tick timeline ring
+                                  (``Config.trace_ticks``), exportable as
+                                  Chrome trace-event JSON (Perfetto);
+- :mod:`deneva_tpu.obs.prog`      periodic ``[prog]`` heartbeat lines
+                                  (``Config.prog_interval``), same
+                                  key=value contract as ``[summary]``;
+- :mod:`deneva_tpu.obs.profiler`  host-side phase timers around
+                                  trace/lower/compile vs execute
+                                  (``Config.profile``) plus structured
+                                  JSON run records under ``results/``.
+"""
+
+from deneva_tpu.obs import prog, profiler, trace  # noqa: F401

@@ -1,0 +1,149 @@
+"""Host-side phase profiler + structured run records.
+
+The reference accumulates host timers around every queue and processing
+phase (statistics/stats.h time families).  In this rebuild the whole tick
+is ONE jit'd XLA program, so the meaningful host-visible phases are:
+
+- ``trace_lower_compile``  first dispatch of a (function, shape) pair:
+                           jax tracing + StableHLO lowering + XLA
+                           compilation (detected by the jit cache growing
+                           across the call);
+- ``dispatch``             steady-state enqueue cost of a cached dispatch;
+- ``execute``              device time to drain the enqueued tick(s)
+                           (``jax.block_until_ready``).
+
+Profiling blocks after every dispatch so phases are real wall times —
+that forfeits host/device pipelining, which is the documented observation
+cost of ``Config.profile`` (never extra device work; the tick graph is
+untouched).  ``jit_recompiles`` counts cache misses — a recompile storm
+mid-run (e.g. a shape-changing host loop) is the single most common
+silent performance bug this catches.
+
+:func:`run_record` assembles a structured JSON document (config
+fingerprint + summary + phase times + optional timeline) and
+:func:`write_run_record` lands it under ``results/`` so every measured
+run leaves a machine-readable artifact next to its ``[summary]`` line.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import time
+from contextlib import contextmanager
+from typing import Any, Optional
+
+import jax
+
+RECORD_SCHEMA = "deneva-tpu/run-record/v1"
+
+
+class PhaseProfiler:
+    """Accumulating phase timers + counters (re-entrant per phase name)."""
+
+    def __init__(self):
+        self.phases: dict[str, dict] = {}
+        self.counters: dict[str, int] = {}
+
+    # -- primitives ----------------------------------------------------
+    def add(self, name: str, seconds: float) -> None:
+        p = self.phases.setdefault(name, {"seconds": 0.0, "count": 0})
+        p["seconds"] += float(seconds)
+        p["count"] += 1
+
+    def count(self, name: str, n: int = 1) -> None:
+        self.counters[name] = self.counters.get(name, 0) + n
+
+    @contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield self
+        finally:
+            self.add(name, time.perf_counter() - t0)
+
+    # -- jit-aware dispatch --------------------------------------------
+    @staticmethod
+    def jit_cache_size(fn) -> Optional[int]:
+        """Compiled-variant count of a jitted callable (None when the
+        running jax version doesn't expose it)."""
+        try:
+            return fn._cache_size()
+        except Exception:
+            return None
+
+    def dispatch(self, fn, *args):
+        """Call a jitted ``fn``, attributing the call to
+        ``trace_lower_compile`` (cache grew => this call traced, lowered
+        and compiled) or ``dispatch``, then block in ``execute``."""
+        before = self.jit_cache_size(fn)
+        t0 = time.perf_counter()
+        out = fn(*args)
+        dt = time.perf_counter() - t0
+        after = self.jit_cache_size(fn)
+        if before is not None and after is not None and after > before:
+            self.add("trace_lower_compile", dt)
+            self.count("jit_recompiles")
+        else:
+            self.add("dispatch", dt)
+        with self.phase("execute"):
+            jax.block_until_ready(out)
+        return out
+
+    # -- reporting ------------------------------------------------------
+    def snapshot(self) -> dict:
+        return {"phases": {k: dict(v) for k, v in self.phases.items()},
+                "counters": dict(self.counters)}
+
+
+def config_fingerprint(cfg) -> str:
+    """Stable short hash of the full Config cell, so run records from the
+    same experiment cell collate regardless of when they ran."""
+    blob = json.dumps(dataclasses.asdict(cfg), sort_keys=True, default=str)
+    return hashlib.sha1(blob.encode()).hexdigest()[:12]
+
+
+def _jsonable(v: Any):
+    if isinstance(v, dict):
+        return {k: _jsonable(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    if hasattr(v, "tolist"):        # numpy/jax arrays AND scalars
+        return v.tolist()
+    return v
+
+
+def run_record(cfg, summary: dict, phases: Optional[dict] = None,
+               timeline: Optional[dict] = None,
+               extra: Optional[dict] = None) -> dict:
+    """Structured record of one measured run: config fingerprint +
+    [summary] contents + profiler snapshot + optional per-tick timeline
+    (obs.trace.timeline output)."""
+    rec = {
+        "schema": RECORD_SCHEMA,
+        "config_fingerprint": config_fingerprint(cfg),
+        "config": _jsonable(dataclasses.asdict(cfg)),
+        "jax_version": jax.__version__,
+        "backend": jax.default_backend(),
+        "unix_time": time.time(),
+        "summary": _jsonable(summary),
+        "profile": _jsonable(phases) if phases else None,
+        "timeline": _jsonable(timeline) if timeline else None,
+    }
+    if extra:
+        rec.update(_jsonable(extra))
+    return rec
+
+
+def write_run_record(record: dict, out_dir: str = "results",
+                     name: Optional[str] = None) -> str:
+    os.makedirs(out_dir, exist_ok=True)
+    if name is None:
+        name = (f"run_{record.get('config_fingerprint', 'unknown')}_"
+                f"{int(record.get('unix_time', time.time()))}.json")
+    path = os.path.join(out_dir, name)
+    with open(path, "w") as f:
+        json.dump(record, f, indent=1)
+    return path

@@ -1,0 +1,51 @@
+"""Periodic ``[prog]`` progress emission — the PROG_TIMER heartbeat
+(reference system/thread.cpp:86-105 + statistics/stats.cpp progress dump).
+
+The reference dumps a cumulative stats snapshot every PROG_TIMER seconds
+so a stalled or convecting run is visible long before the final
+``[summary]``.  Here the heartbeat is tick-driven (``Config.prog_interval``
+or the engines' ``prog_every`` argument) and renders the SAME key=value
+vocabulary through ``stats.format_summary(..., prog=True)`` — every
+``[prog]`` line round-trips through ``stats.parse_summary`` exactly like
+a ``[summary]`` line, so downstream parsers can plot the run's trajectory
+from a log alone.
+
+Each emission syncs the device (the stats fetch blocks on the in-flight
+tick) — an observation cost paid only when enabled.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+
+class ProgressEmitter:
+    """Collects and prints ``[prog]`` lines for an engine's run loop.
+
+    ``interval``: emit every that-many ticks (0/None = never).
+    ``out``: sink callable (defaults to ``print(..., flush=True)``);
+    emitted lines are also kept on ``self.lines`` so harnesses and tests
+    can parse them without capturing stdout.
+    """
+
+    def __init__(self, engine, interval: Optional[int],
+                 out: Optional[Callable[[str], None]] = None):
+        self.engine = engine
+        self.interval = int(interval or 0)
+        self.out = out
+        self.lines: list[str] = []
+
+    def maybe_emit(self, state, ticks_done: int) -> Optional[str]:
+        """Call once per tick with the 1-based tick count of this run."""
+        if self.interval > 0 and ticks_done % self.interval == 0:
+            return self.emit(state)
+        return None
+
+    def emit(self, state) -> str:
+        line = self.engine.summary_line(state, prog=True)
+        self.lines.append(line)
+        if self.out is not None:
+            self.out(line)
+        else:
+            print(line, flush=True)
+        return line

@@ -1,0 +1,154 @@
+"""Device-resident tick timeline (the DEBUG_TIMELINE analog, reference
+config.h:269 + scripts/timeline.py).
+
+One preallocated ``(Config.trace_ticks, K)`` int32 ring buffer rides the
+scheduler's carry (inside the stats dict, so the ``lax.while_loop`` /
+``fori_loop`` body threads it like every other counter).  Each tick the
+engine accumulates ONE row — admissions, commits, aborts by reason,
+lock-wait decisions, and the slot-status occupancy histogram — with a
+single row scatter (cheap on TPU: unique index, contiguous second dim).
+Ticks past the depth wrap (``t % T``) and ACCUMULATE, so column sums
+always equal the whole run's totals even when the buffer is shorter than
+the run; for per-tick plots pick ``trace_ticks`` >= the run length.
+
+In ``ShardedEngine`` the stats dict is stacked over the node axis, so the
+buffer is ``(N, T, K)`` and per-shard commit counts (shard imbalance) come
+for free from the leading axis.
+
+The buffer is fetched from device ONCE at run end; host-side exports:
+
+- :func:`timeline`         named numpy series for
+                           ``experiments/timeline_plot.py``;
+- :func:`totals`           column sums (reconcile against ``[summary]``);
+- :func:`to_chrome_trace`  Chrome trace-event JSON, loadable in Perfetto
+                           (https://ui.perfetto.dev) as counter tracks.
+
+When ``Config.trace_ticks == 0`` (default) no arrays exist and the tick
+graph is bit-identical to a build without this module.
+"""
+
+from __future__ import annotations
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+
+from deneva_tpu.engine.state import (STATUS_BACKOFF, STATUS_FREE,
+                                     STATUS_RUNNING, STATUS_WAITING)
+
+#: trace row schema.  Flow columns are per-tick event counts; ``abort``
+#: is the tick's total_txn_abort_cnt increment (cc aborts + validation
+#: aborts), ``vabort``/``user_abort`` the reason breakdown, ``lock_wait``
+#: the tick's WAIT decisions (parked continuations).  The ``occ_*``
+#: columns are the end-of-tick slot-status histogram (they sum to B).
+TRACE_COLUMNS = ("admit", "commit", "abort", "vabort", "user_abort",
+                 "lock_wait", "occ_free", "occ_running", "occ_waiting",
+                 "occ_backoff")
+COL = {name: i for i, name in enumerate(TRACE_COLUMNS)}
+
+#: columns grouped into Perfetto counter tracks
+_FLOW = ("admit", "commit", "abort", "vabort", "user_abort", "lock_wait")
+_OCC = ("occ_free", "occ_running", "occ_waiting", "occ_backoff")
+
+
+def init_trace(cfg, lat_samples: int) -> dict:
+    """Stats-dict entries for the timeline; empty when tracing is off
+    (the disabled path carries nothing)."""
+    if cfg.trace_ticks <= 0:
+        return {}
+    return {
+        "arr_trace": jnp.zeros((cfg.trace_ticks, len(TRACE_COLUMNS)),
+                               jnp.int32),
+        # lifetime companion ring: commit-latency samples also record
+        # their start tick so recent txn lifetimes can be drawn
+        # (record_commit_latency fills it; timeline_plot.py reads it)
+        "arr_lat_start": jnp.zeros(lat_samples, jnp.int32),
+    }
+
+
+def record_tick(stats: dict, t, status, *, admit, commit, abort, vabort,
+                user_abort, lock_wait) -> dict:
+    """Accumulate this tick's row (device side; no-op unless the buffer
+    exists).  NOT warmup-gated — the timeline shows warmup dynamics too,
+    so column sums match the warmup-gated [summary] counters exactly only
+    when ``warmup_ticks == 0``."""
+    if "arr_trace" not in stats:
+        return stats
+    buf = stats["arr_trace"]
+    occ = [jnp.sum((status == s).astype(jnp.int32))
+           for s in (STATUS_FREE, STATUS_RUNNING, STATUS_WAITING,
+                     STATUS_BACKOFF)]
+    row = jnp.stack([jnp.asarray(v, jnp.int32) for v in
+                     (admit, commit, abort, vabort, user_abort, lock_wait)]
+                    + occ)
+    return {**stats,
+            "arr_trace": buf.at[t % buf.shape[0]].add(
+                row, unique_indices=True)}
+
+
+def _buffer(state_or_stats) -> np.ndarray:
+    stats = getattr(state_or_stats, "stats", state_or_stats)
+    assert "arr_trace" in stats, "run with Config.trace_ticks > 0"
+    return np.asarray(stats["arr_trace"])
+
+
+def timeline(state_or_stats, per_shard: bool = False) -> dict:
+    """Named numpy series, one ``(T,)`` array per column (sharded buffers
+    sum the node axis for the cluster-wide view unless ``per_shard``,
+    which keeps them ``(N, T)``)."""
+    a = _buffer(state_or_stats)
+    if a.ndim == 3 and not per_shard:
+        a = a.sum(axis=0)
+    if a.ndim == 3:
+        return {name: a[:, :, i] for i, name in enumerate(TRACE_COLUMNS)}
+    return {name: a[:, i] for i, name in enumerate(TRACE_COLUMNS)}
+
+
+def totals(state_or_stats) -> dict:
+    """Whole-run column sums (occupancy columns integrate to
+    slot-ticks).  These reconcile exactly with the [summary] counters
+    commits/aborts/admissions when ``warmup_ticks == 0``."""
+    a = _buffer(state_or_stats)
+    flat = a.reshape(-1, a.shape[-1]).sum(axis=0)
+    return {name: int(flat[i]) for i, name in enumerate(TRACE_COLUMNS)}
+
+
+def to_chrome_trace(state_or_stats, path: str, n_ticks: int | None = None,
+                    tick_us: float = 1.0) -> str:
+    """Export the timeline as Chrome trace-event JSON (the JSON Array
+    Format with counter events, loadable at ui.perfetto.dev).
+
+    One process per shard; two counter tracks per shard (txn flow and
+    slot occupancy).  ``tick_us`` maps one scheduler tick onto the trace
+    timebase (pass the measured mean tick microseconds for wall-true
+    plots; the default keeps tick units)."""
+    a = _buffer(state_or_stats)
+    shards = a[None] if a.ndim == 2 else a          # (N, T, K)
+    N, T, _ = shards.shape
+    if n_ticks is not None:
+        T = min(T, int(n_ticks))
+    events = []
+    for node in range(N):
+        events.append({"name": "process_name", "ph": "M", "pid": node,
+                       "tid": 0,
+                       "args": {"name": f"shard{node}" if N > 1
+                                else "engine"}})
+        buf = shards[node]
+        for t in range(T):
+            ts = float(t) * tick_us
+            events.append({"name": "txn flow", "ph": "C", "ts": ts,
+                           "pid": node,
+                           "args": {c: int(buf[t, COL[c]])
+                                    for c in _FLOW}})
+            events.append({"name": "slot occupancy", "ph": "C", "ts": ts,
+                           "pid": node,
+                           "args": {c: int(buf[t, COL[c]])
+                                    for c in _OCC}})
+    doc = {"traceEvents": events, "displayTimeUnit": "ms",
+           "metadata": {"tool": "deneva_tpu.obs.trace",
+                        "columns": list(TRACE_COLUMNS),
+                        "tick_us": tick_us, "shards": N, "ticks": T}}
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return path

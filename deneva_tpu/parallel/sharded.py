@@ -57,8 +57,10 @@ from deneva_tpu.engine.scheduler import (STAT_KEYS_F32, STAT_KEYS_I32,  # noqa: 
                                          bump, recon_defer,
                                          record_commit_latency,
                                          track_parts_touched,
-                                         track_state_latencies,
-                                         trace_tick_events)
+                                         track_state_latencies)
+from deneva_tpu.obs import trace as obs_trace
+from deneva_tpu.obs.prog import ProgressEmitter
+from deneva_tpu.obs.profiler import PhaseProfiler
 from deneva_tpu.engine.state import (BIG_TS, NULL_KEY, STATUS_BACKOFF,
                                      STATUS_FREE, STATUS_RUNNING,
                                      STATUS_WAITING, TxnState)
@@ -830,9 +832,17 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
         # network = entry-ticks shipped to remote owners this tick)
         stats = track_state_latencies(stats, txn, measuring)
         if cfg.trace_ticks > 0:
-            stats = trace_tick_events(
-                stats, t, n_free, n_commit,
-                jnp.sum(abort_now.astype(jnp.int32)), txn)
+            # per-shard row (the stats dict is per-node under shard_map, so
+            # the fetched buffer stacks to (N, T, K): per-shard commit
+            # counts — shard imbalance — come from the leading axis)
+            stats = obs_trace.record_tick(
+                stats, t, txn.status,
+                admit=n_free,
+                commit=n_commit,
+                abort=jnp.sum(abort_now.astype(jnp.int32)),
+                vabort=jnp.sum(vabort.astype(jnp.int32)),
+                user_abort=jnp.sum(ua.astype(jnp.int32)),
+                lock_wait=jnp.sum(wait.astype(jnp.int32)))
         if dly:
             # with a real delay model, network time is the per-tick count
             # of txns blocked purely on message transit (integrates to
@@ -971,6 +981,8 @@ class ShardedEngine:
 
         self._spmd_tick = spmd_tick
         self._jit_tick = None
+        # host-side phase profiler (obs/profiler.py); None when disabled
+        self.profiler = PhaseProfiler() if cfg.profile else None
 
     def init_state(self) -> ShardState:
         cfg = self.cfg
@@ -1017,10 +1029,15 @@ class ShardedEngine:
         self._build()
         if state is None:
             state = self.init_state()
+        if prog_every is None:
+            prog_every = self.cfg.prog_interval
+        prog = ProgressEmitter(self, prog_every)
         for i in range(n_ticks):
-            state = self._jit_tick(state)
-            if prog_every and (i + 1) % prog_every == 0:
-                print(self.summary_line(state, prog=True), flush=True)
+            if self.profiler is not None:
+                state = self.profiler.dispatch(self._jit_tick, state)
+            else:
+                state = self._jit_tick(state)
+            prog.maybe_emit(state, i + 1)
         return state
 
     def run_compiled(self, n_ticks: int, state=None):
@@ -1041,10 +1058,19 @@ class ShardedEngine:
 
         f = shard_map(spmd_many, mesh=self.mesh,
                       in_specs=(spec, spec, spec), out_specs=spec)
-        return jax.jit(f, donate_argnums=0)(state, self.pool_stacked,
-                                            self._node_idx if
-                                            self._jit_tick else
-                                            jnp.arange(N, dtype=jnp.int32))
+        node_idx = (self._node_idx if self._jit_tick
+                    else jnp.arange(N, dtype=jnp.int32))
+        jf = jax.jit(f, donate_argnums=0)
+        if self.profiler is None:
+            return jf(state, self.pool_stacked, node_idx)
+        # a fresh jit is built each call, so every run_compiled recompiles:
+        # a combined trace/lower/compile+dispatch phase, then execute
+        self.profiler.count("jit_recompiles")
+        with self.profiler.phase("trace_lower_compile"):
+            out = jf(state, self.pool_stacked, node_idx)
+        with self.profiler.phase("execute"):
+            jax.block_until_ready(out)
+        return out
 
     def summary(self, state: ShardState, wall_seconds: float | None = None
                 ) -> dict:

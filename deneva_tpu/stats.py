@@ -98,6 +98,23 @@ def reference_summary(s: dict, wall_seconds: float | None = None) -> dict:
               "occ_active_abort_cnt", "mvcc_tail_fold_cnt"):
         if k in s:
             out[k] = s[k]
+    # reference-name ALIASES for the invented chain counters, so parsers
+    # of reference-format summaries (stats.cpp:907 prints case1..6) keep
+    # their maat_caseN_cnt fields.  The reference's case2/4/5 fire against
+    # snapshot members still validated at validation time — a state the
+    # synchronous tick consolidates (cc/maat.py init_db) — so the closest
+    # mechanical equivalents are exported under the reference names:
+    #   maat_case2_cnt <- maat_chain_cap_cnt  (upper tightened by a
+    #                     concurrent uncommitted validator)
+    #   maat_case4_cnt <- maat_chain_push_cnt (lower raised past one)
+    #   maat_case6_cnt <- maat_range_abort_cnt (range emptied -> abort)
+    # case5 pairs are resolved inside the case1/3 prefix scans and have
+    # no separate counter here.
+    for alias, src in (("maat_case2_cnt", "maat_chain_cap_cnt"),
+                       ("maat_case4_cnt", "maat_chain_push_cnt"),
+                       ("maat_case6_cnt", "maat_range_abort_cnt")):
+        if src in s:
+            out[alias] = s[src]
     if "ccl_samples" in s:
         ccl = latency_percentiles(s["ccl_samples"], s.get("ccl_valid", 0))
         out.update({k: v * tick_sec for k, v in ccl.items()})
@@ -143,11 +160,18 @@ def format_summary(d: dict, prog: bool = False) -> str:
 
 
 def parse_summary(line: str) -> dict:
-    """Port of parse_results.py get_summary/process_results (:19-37)."""
-    if not re.search("summary", line):
-        return {}
+    """Port of parse_results.py get_summary/process_results (:19-37).
+
+    Also accepts ``[prog]`` heartbeat lines — they carry the exact same
+    key=value payload (obs/prog.py), so progress can be plotted from a
+    log with the same parser."""
     line = line.rstrip("\n")
-    line = line[10:]                       # remove '[summary] '
+    if line.startswith("[summary] "):
+        line = line[10:]
+    elif line.startswith("[prog] "):
+        line = line[7:]
+    else:
+        return {}
     out = {}
     for r in re.split(",", line):
         name, val = re.split("=", r)

@@ -21,19 +21,15 @@ import matplotlib
 matplotlib.use("Agg")
 import matplotlib.pyplot as plt  # noqa: E402
 
+from deneva_tpu.obs import trace as obs_trace  # noqa: E402
 from experiments._plot_style import INK, PALETTE, style_axes  # noqa: E402
 
-SERIES = {"admitted": PALETTE[0], "committed": PALETTE[2],
-          "aborted": PALETTE[1], "waiting slots": PALETTE[3]}
-
-
-def _series(stats, key, T):
-    """Per-tick trace series; sharded states carry (N, T) arrays — sum
-    the node axis for the cluster-wide view."""
-    a = np.asarray(stats[key])
-    if a.ndim == 2:
-        a = a.sum(axis=0)
-    return a[:T]
+#: panel label -> (obs.trace column, color); obs.trace.timeline sums the
+#: node axis of sharded (N, T, K) buffers for the cluster-wide view
+SERIES = {"admitted": ("admit", PALETTE[0]),
+          "committed": ("commit", PALETTE[2]),
+          "aborted": ("abort", PALETTE[1]),
+          "waiting slots": ("occ_waiting", PALETTE[3])}
 
 
 def _lifetimes(stats):
@@ -56,12 +52,8 @@ def render(eng, state, path: str, max_lifetimes: int = 200):
     cfg = eng.cfg
     assert cfg.trace_ticks > 0, "run with Config.trace_ticks > 0"
     T = min(int(np.asarray(state.tick).max()), cfg.trace_ticks)
-    series = {
-        "admitted": _series(state.stats, "arr_trace_admit", T),
-        "committed": _series(state.stats, "arr_trace_commit", T),
-        "aborted": _series(state.stats, "arr_trace_abort", T),
-        "waiting slots": _series(state.stats, "arr_trace_waiting", T),
-    }
+    tl = obs_trace.timeline(state.stats)
+    series = {name: tl[col][:T] for name, (col, _) in SERIES.items()}
 
     start, dur = _lifetimes(state.stats)
     k = min(max_lifetimes, start.shape[0])
@@ -70,7 +62,7 @@ def render(eng, state, path: str, max_lifetimes: int = 200):
                                    height_ratios=[1, 1.2])
     for name, ys in series.items():
         ax1.plot(np.arange(T), ys, linewidth=2, label=name,
-                 color=SERIES[name])
+                 color=SERIES[name][1])
     style_axes(ax1, "tick", "count", "per-tick events")
     ax1.legend(fontsize=7, frameon=False, ncol=4, labelcolor=INK)
 

@@ -67,6 +67,25 @@ def test_squeezed_to_empty_range_aborts():
     assert np.asarray(st.data).sum() == s["write_cnt"]
 
 
+def test_single_key_writers_serialize():
+    # Degenerate single-key cell: every entry lands in ONE sorted segment, so
+    # the jnp.roll(·, d) pair windows wrap around the array end.  Before the
+    # `lane >= d` masks, the wrapped pairs poisoned the chain classification
+    # and no caps fired at all — four same-tick writers of the same row all
+    # committed unserialized (data[5] counted every "commit", not one write
+    # per serialized winner).
+    keys = np.full((4, 1), 5, np.int32)
+    pool = make_pool(keys, np.ones((4, 1), bool))
+    eng = Engine(small_cfg(cc_alg="MAAT", req_per_query=1, batch_size=4,
+                           query_pool_size=4), pool=pool)
+    st = eng.run(8)
+    s = eng.summary(st)
+    assert int(np.asarray(st.data)[5]) == s["txn_cnt"]  # one write per commit
+    assert s["vabort_cnt"] > 0            # concurrent writers now conflict
+    assert s["maat_chain_cap_cnt"] > 0    # chain caps actually fire
+    assert s["maat_chain_overflow_cnt"] == 0  # 4 validators <= window 8
+
+
 @pytest.mark.parametrize("window", [1, 4])
 def test_oracle_and_better_than_nowait_commit_rate(window):
     # MaaT should commit at least as much as NO_WAIT under rw-heavy

@@ -1,0 +1,203 @@
+"""Observability subsystem tests (deneva_tpu/obs): [prog] round-trip,
+trace-vs-summary reconciliation, Chrome-trace schema, profiler phases,
+run records, and the disabled path's bit-identical summaries."""
+
+import json
+
+import numpy as np
+
+from deneva_tpu import stats as stats_mod
+from deneva_tpu.config import Config
+from deneva_tpu.engine.scheduler import Engine
+from deneva_tpu.obs import profiler as obs_profiler
+from deneva_tpu.obs import trace as obs_trace
+from deneva_tpu.obs.prog import ProgressEmitter
+
+BASE = dict(cc_alg="NO_WAIT", batch_size=128, synth_table_size=1 << 10,
+            req_per_query=4, zipf_theta=0.8, query_pool_size=1 << 10)
+
+
+def run(n_ticks=30, **kw):
+    eng = Engine(Config(**{**BASE, **kw}))
+    return eng, eng.run(n_ticks)
+
+
+# ---- [prog] ---------------------------------------------------------------
+
+def test_prog_lines_round_trip():
+    eng = Engine(Config(**BASE, prog_interval=10))
+    sink = []
+    prog = ProgressEmitter(eng, eng.cfg.prog_interval, out=sink.append)
+    state = None
+    for i in range(30):
+        state = eng._tick_jit(state if state is not None
+                              else eng.init_state())
+        prog.maybe_emit(state, i + 1)
+    assert len(sink) == 3 and sink == prog.lines
+    final = stats_mod.parse_summary(eng.summary_line(state))
+    for line in sink:
+        assert line.startswith("[prog] ")
+        parsed = stats_mod.parse_summary(line)
+        assert set(parsed) == set(final)
+    # cumulative counters are monotone across heartbeats
+    cnts = [stats_mod.parse_summary(ln)["txn_cnt"] for ln in sink]
+    assert cnts == sorted(cnts)
+    assert cnts[-1] <= final["txn_cnt"]
+
+
+def test_run_emits_prog_from_config(capsys):
+    eng = Engine(Config(**BASE, prog_interval=10))
+    eng.run(20)
+    lines = [ln for ln in capsys.readouterr().out.splitlines()
+             if ln.startswith("[prog] ")]
+    assert len(lines) == 2
+    assert stats_mod.parse_summary(lines[-1])["txn_cnt"] >= 0
+
+
+# ---- trace reconciliation -------------------------------------------------
+
+def test_trace_totals_reconcile_with_summary():
+    eng, st = run(trace_ticks=64)
+    s = eng.summary(st)
+    tot = obs_trace.totals(st)
+    assert tot["commit"] == s["txn_cnt"]
+    assert tot["abort"] == s["total_txn_abort_cnt"]
+    assert tot["admit"] == s["local_txn_start_cnt"]
+    assert tot["vabort"] == s["vabort_cnt"]
+    assert tot["user_abort"] == s["user_abort_cnt"]
+    assert tot["lock_wait"] == s["twopl_wait_cnt"]
+    # occupancy columns integrate to the latency decomposition
+    assert tot["occ_running"] == s["lat_process_time"]
+    assert tot["occ_waiting"] == s["lat_cc_block_time"]
+    assert tot["occ_backoff"] == s["lat_abort_time"]
+
+
+def test_trace_reconciles_commit_after_access():
+    # the other commit ordering splits abort bumps into abort_now + vabort;
+    # the abort column must still integrate to total_txn_abort_cnt
+    eng, st = run(cc_alg="OCC", commit_after_access=True, trace_ticks=64)
+    s = eng.summary(st)
+    tot = obs_trace.totals(st)
+    assert tot["commit"] == s["txn_cnt"]
+    assert tot["abort"] == s["total_txn_abort_cnt"]
+    assert tot["vabort"] == s["vabort_cnt"]
+
+
+def test_timeline_series_shapes():
+    eng, st = run(trace_ticks=64)
+    tl = obs_trace.timeline(st)
+    assert set(tl) == set(obs_trace.TRACE_COLUMNS)
+    assert all(v.shape == (64,) for v in tl.values())
+    occ = sum(tl[c] for c in ("occ_free", "occ_running", "occ_waiting",
+                              "occ_backoff"))
+    ticks = int(np.asarray(st.tick))
+    assert (occ[:ticks] == eng.cfg.batch_size).all()
+
+
+# ---- Chrome trace export --------------------------------------------------
+
+def test_chrome_trace_schema(tmp_path):
+    eng, st = run(trace_ticks=64)
+    path = obs_trace.to_chrome_trace(st, str(tmp_path / "trace.json"),
+                                     n_ticks=30)
+    with open(path) as f:
+        doc = json.load(f)
+    assert isinstance(doc["traceEvents"], list)
+    counters = [e for e in doc["traceEvents"] if e.get("ph") == "C"]
+    metas = [e for e in doc["traceEvents"] if e.get("ph") == "M"]
+    assert len(metas) == 1 and metas[0]["name"] == "process_name"
+    assert len(counters) == 2 * 30      # txn flow + slot occupancy per tick
+    for e in counters:
+        assert {"name", "ph", "ts", "pid", "args"} <= set(e)
+        assert e["name"] in ("txn flow", "slot occupancy")
+        assert all(isinstance(v, int) for v in e["args"].values())
+    # flow counter events integrate to the same totals as the buffer
+    commits = sum(e["args"]["commit"] for e in counters
+                  if e["name"] == "txn flow")
+    assert commits == eng.summary(st)["txn_cnt"]
+
+
+# ---- disabled path --------------------------------------------------------
+
+def test_disabled_path_bit_identical_and_lean():
+    eng_obs, st_obs = run(trace_ticks=64, prog_interval=0)
+    eng_off, st_off = run(trace_ticks=0)
+    assert "arr_trace" not in st_off.stats
+    assert "arr_lat_start" not in st_off.stats
+    assert eng_off.profiler is None
+    # tracing must not perturb the simulation: summaries bit-identical
+    assert eng_off.summary(st_off) == eng_obs.summary(st_obs)
+
+
+# ---- profiler + run record ------------------------------------------------
+
+def test_profiler_phases_and_recompile_count():
+    eng, st = run(profile=True)
+    snap = eng.profiler.snapshot()
+    assert snap["counters"]["jit_recompiles"] == 1     # one tick compile
+    assert snap["phases"]["trace_lower_compile"]["count"] == 1
+    assert snap["phases"]["execute"]["count"] == 30
+    assert snap["phases"]["dispatch"]["count"] == 29   # post-compile ticks
+    assert all(p["seconds"] >= 0 for p in snap["phases"].values())
+
+
+def test_run_record_written(tmp_path):
+    eng, st = run(trace_ticks=64, profile=True)
+    summary = eng.summary(st)
+    rec = obs_profiler.run_record(
+        eng.cfg, summary, phases=eng.profiler.snapshot(),
+        timeline=obs_trace.timeline(st))
+    path = obs_profiler.write_run_record(rec, out_dir=str(tmp_path))
+    with open(path) as f:
+        loaded = json.load(f)
+    assert loaded["schema"] == obs_profiler.RECORD_SCHEMA
+    assert loaded["config_fingerprint"] == \
+        obs_profiler.config_fingerprint(eng.cfg)
+    assert loaded["summary"]["txn_cnt"] == summary["txn_cnt"]
+    assert loaded["config"]["trace_ticks"] == 64
+    assert sum(loaded["timeline"]["commit"]) == summary["txn_cnt"]
+    assert loaded["profile"]["counters"]["jit_recompiles"] >= 1
+
+
+def test_fingerprint_tracks_config_not_run():
+    a = Config(**BASE)
+    b = Config(**BASE)
+    c = Config(**{**BASE, "zipf_theta": 0.99})
+    assert obs_profiler.config_fingerprint(a) == \
+        obs_profiler.config_fingerprint(b)
+    assert obs_profiler.config_fingerprint(a) != \
+        obs_profiler.config_fingerprint(c)
+
+
+def test_run_compiled_profiled():
+    eng = Engine(Config(**BASE, profile=True))
+    st = eng.run_compiled(10)
+    st = eng.run_compiled(10, st)        # second call: cached scan
+    snap = eng.profiler.snapshot()
+    assert snap["phases"]["trace_lower_compile"]["count"] == 1
+    assert snap["phases"]["dispatch"]["count"] == 1
+    assert int(np.asarray(st.stats["measured_ticks"])) == 20
+
+
+# ---- sharded --------------------------------------------------------------
+
+def test_sharded_trace_per_shard_commits():
+    import pytest
+    try:
+        from deneva_tpu.parallel.sharded import ShardedEngine
+    except ImportError as e:         # pragma: no cover - jax api drift
+        pytest.skip(f"sharded engine unavailable: {e}")
+    cfg = Config(cc_alg="NO_WAIT", node_cnt=2, part_cnt=2, batch_size=32,
+                 synth_table_size=1 << 10, req_per_query=4, zipf_theta=0.6,
+                 query_pool_size=512, trace_ticks=32, profile=True)
+    eng = ShardedEngine(cfg)
+    st = eng.run(20)
+    s = eng.summary(st)
+    tot = obs_trace.totals(st)
+    assert tot["commit"] == s["txn_cnt"]
+    assert tot["abort"] == s["total_txn_abort_cnt"]
+    per_shard = obs_trace.timeline(st, per_shard=True)["commit"]
+    assert per_shard.shape == (2, 32)
+    snap = eng.profiler.snapshot()
+    assert snap["counters"]["jit_recompiles"] >= 1
+    assert snap["phases"]["execute"]["count"] == 20

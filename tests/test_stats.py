@@ -94,7 +94,14 @@ def test_prog_line_tag():
     eng, st = run_engine()
     line = eng.summary_line(st, prog=True)
     assert line.startswith("[prog] ")
-    assert stats_mod.parse_summary(line) == {}   # parser only takes summary
+    # [prog] lines carry the same key=value payload as [summary] and
+    # round-trip through the same parser (obs/prog.py contract)
+    parsed = stats_mod.parse_summary(line)
+    summary = stats_mod.parse_summary(eng.summary_line(st))
+    assert set(parsed) == set(summary)
+    assert parsed["txn_cnt"] == summary["txn_cnt"]
+    # anything else still parses to nothing
+    assert stats_mod.parse_summary("no tag here k=1") == {}
 
 
 def test_cc_case_counter_families():
@@ -112,6 +119,11 @@ def test_cc_case_counter_families():
     # contention at zipf 0.8 must actually exercise the case machinery
     assert parsed["maat_case1_cnt"] > 0
     assert parsed["maat_range_abort_cnt"] >= 0
+    # reference-name aliases of the chain counters (stats.py documents
+    # the case2/4/6 mapping) so reference-format parsers keep the fields
+    assert parsed["maat_case2_cnt"] == parsed["maat_chain_cap_cnt"]
+    assert parsed["maat_case4_cnt"] == parsed["maat_chain_push_cnt"]
+    assert parsed["maat_case6_cnt"] == parsed["maat_range_abort_cnt"]
 
     eng, st = run_engine(cc_alg="OCC")
     parsed = stats_mod.parse_summary(eng.summary_line(st, wall_seconds=1.0))

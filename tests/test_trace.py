@@ -1,11 +1,12 @@
-"""Event-trace (DEBUG_TIMELINE analog) tests: the per-tick series must
-integrate to the run's totals, and lifetimes in the ring must match the
-latency stats."""
+"""Event-trace (DEBUG_TIMELINE analog) tests: the per-tick timeline ring
+must integrate to the run's totals, and lifetimes in the ring must match
+the latency stats."""
 
 import numpy as np
 
 from deneva_tpu.config import Config
 from deneva_tpu.engine.scheduler import Engine
+from deneva_tpu.obs import trace as obs_trace
 
 
 def run_traced(**kw):
@@ -21,15 +22,34 @@ def run_traced(**kw):
 def test_series_integrate_to_totals():
     eng, st = run_traced()
     s = eng.summary(st)
-    commits = np.asarray(st.stats["arr_trace_commit"])
-    aborts = np.asarray(st.stats["arr_trace_abort"])
-    admits = np.asarray(st.stats["arr_trace_admit"])
-    assert int(commits.sum()) == s["txn_cnt"]
-    assert int(aborts.sum()) == s["total_txn_abort_cnt"]
-    assert int(admits.sum()) == s["local_txn_start_cnt"]
-    # waiting series integrates to the cc-block latency integral
-    waiting = np.asarray(st.stats["arr_trace_waiting"])
-    assert float(waiting.sum()) == s["lat_cc_block_time"]
+    tot = obs_trace.totals(st)
+    assert tot["commit"] == s["txn_cnt"]
+    assert tot["abort"] == s["total_txn_abort_cnt"]
+    assert tot["admit"] == s["local_txn_start_cnt"]
+    assert tot["lock_wait"] == s["twopl_wait_cnt"]
+    # the waiting-occupancy series integrates to the cc-block latency
+    # integral (both count WAITING slot-ticks at end of tick)
+    assert float(tot["occ_waiting"]) == s["lat_cc_block_time"]
+
+
+def test_ring_wraps_and_accumulates():
+    # buffer shorter than the run: the ring wraps (t % T) and ADDS, so
+    # column sums still equal whole-run totals
+    eng, st = run_traced(trace_ticks=16)
+    s = eng.summary(st)
+    assert st.stats["arr_trace"].shape[0] == 16
+    tot = obs_trace.totals(st)
+    assert tot["commit"] == s["txn_cnt"]
+    assert tot["abort"] == s["total_txn_abort_cnt"]
+
+
+def test_occupancy_partitions_batch():
+    eng, st = run_traced()
+    tl = obs_trace.timeline(st)
+    occ = sum(tl[c] for c in ("occ_free", "occ_running", "occ_waiting",
+                              "occ_backoff"))
+    ticks = int(np.asarray(st.tick))
+    assert (occ[:ticks] == eng.cfg.batch_size).all()
 
 
 def test_lifetimes_match_ring():
@@ -46,7 +66,7 @@ def test_lifetimes_match_ring():
 
 def test_trace_off_carries_no_arrays():
     eng, st = run_traced(trace_ticks=0)
-    assert "arr_trace_commit" not in st.stats
+    assert "arr_trace" not in st.stats
     assert "arr_lat_start" not in st.stats
 
 
@@ -66,5 +86,11 @@ def test_sharded_trace():
     eng = ShardedEngine(cfg)
     st = eng.run(25)
     s = eng.summary(st)
-    commits = np.asarray(st.stats["arr_trace_commit"])  # (N, T)
-    assert int(commits.sum()) == s["txn_cnt"]
+    buf = np.asarray(st.stats["arr_trace"])
+    assert buf.shape == (4, 32, len(obs_trace.TRACE_COLUMNS))
+    tot = obs_trace.totals(st)
+    assert tot["commit"] == s["txn_cnt"]
+    # per-shard commit series come from the leading axis
+    per_shard = obs_trace.timeline(st, per_shard=True)["commit"]
+    assert per_shard.shape == (4, 32)
+    assert int(per_shard.sum()) == s["txn_cnt"]
