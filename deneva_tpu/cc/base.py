@@ -33,6 +33,43 @@ from deneva_tpu.config import Config
 from deneva_tpu.engine.state import TxnState
 
 
+class HookSpec(NamedTuple):
+    """Machine-readable kernel-hook signature, consumed by the static
+    analyzer (deneva_tpu/lint/jaxpr_engine.py).
+
+    ``args``: symbolic names of the hook's arguments after the fixed
+    (cfg, db) prefix; the verifier materializes each as an abstract value
+    (see lint/contract.py ARG_BUILDERS).  ``returns``: the output
+    protocol — ``"db"`` the updated db dict (same pytree structure,
+    shapes and dtypes as the input db), ``"decision"`` an AccessDecision
+    of (B, R) bool masks, ``"votes"`` a (B,) bool mask.  A single-element
+    ``returns`` means the hook returns that value directly; otherwise a
+    tuple in this order.
+    """
+
+    args: tuple
+    returns: tuple
+
+
+#: The plugin-boundary contract: every registered plugin's hooks must
+#: abstract-eval under these signatures with a structure-stable db.
+#: Enforced by `python -m deneva_tpu.lint` (engine 2) and scripts/check.sh.
+KERNEL_CONTRACT: dict = {
+    "on_start": HookSpec(args=("txn", "mask_b"), returns=("db",)),
+    "access": HookSpec(args=("txn", "mask_b"), returns=("decision", "db")),
+    "validate": HookSpec(args=("txn", "mask_b", "tick"),
+                         returns=("votes", "db")),
+    "on_commit": HookSpec(args=("txn", "mask_b", "ts_b", "tick"),
+                          returns=("db",)),
+    "on_abort": HookSpec(args=("txn", "mask_b"), returns=("db",)),
+    "on_finalize_entries": HookSpec(args=("keys_e", "ts_e", "mask_e"),
+                                    returns=("db",)),
+    "on_prepared_entries": HookSpec(args=("keys_e", "ts_e", "mask_e",
+                                          "tick"), returns=("db",)),
+    "on_ts_rebase": HookSpec(args=("tick",), returns=("db",)),
+}
+
+
 class AccessDecision(NamedTuple):
     """Per-access outcome for this tick's requests; masks are (B, R) and
     mutually exclusive, true only at requested access positions (the window

@@ -239,9 +239,16 @@ class Mvcc(CCPlugin):
         slot = jnp.sum(jnp.where(onehot, slot_asc, 0), axis=1)
         old_at_p = jnp.sum(jnp.where(onehot, ring_asc, 0), axis=1)
 
-        iflat = jnp.where(survive, kk * H + slot, n_rows * H)
-        w_ring = db["w_ring"].at[iflat].set(stsK, mode="drop")
-        r_ring = db["r_ring"].at[iflat].set(0, mode="drop")
+        # survivors land on distinct ring cells (per row, distinct ranks p
+        # pick distinct old slots via the slot_asc permutation); folded
+        # lanes map to DISTINCT out-of-bounds cells so unique_indices=True
+        # holds globally and the .set scatters stay order-independent
+        iflat = jnp.where(survive, kk * H + slot,
+                          n_rows * H + jnp.arange(K, dtype=jnp.int32))
+        w_ring = db["w_ring"].at[iflat].set(stsK, mode="drop",
+                                            unique_indices=True)
+        r_ring = db["r_ring"].at[iflat].set(0, mode="drop",
+                                            unique_indices=True)
         w_floor = db["w_floor"].at[jnp.where(sliveK, kk, n_rows)].max(
             jnp.where(survive, old_at_p, stsK), mode="drop")
 

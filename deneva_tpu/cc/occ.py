@@ -278,11 +278,15 @@ class Occ(CCPlugin):
             # point serializes them)
             wm = valid[:, None] & txn.is_write & (ridx < txn.n_req[:, None])
             keysf = jnp.where(wm, txn.keys, NULL_KEY).reshape(-1)
-            db = {**db,
-                  "occ_prep": db["occ_prep"].at[keysf].set(
-                      ts, mode="drop"),
-                  "occ_prep_until": db["occ_prep_until"].at[keysf].set(
-                      tick + cfg.net_delay_ticks + 2, mode="drop")}
+            # lint: disable-next=SCATTER-RACE live keys are exclusive
+            # (keys unique within a txn; two same-tick valid writers of a
+            # row impossible, the fixed point serializes them) and dead
+            # lanes drop out of bounds at NULL_KEY
+            prep = db["occ_prep"].at[keysf].set(ts, mode="drop")
+            # lint: disable-next=SCATTER-RACE same exclusivity invariant
+            until = db["occ_prep_until"].at[keysf].set(
+                tick + cfg.net_delay_ticks + 2, mode="drop")
+            db = {**db, "occ_prep": prep, "occ_prep_until": until}
         return valid, db
 
     def on_commit(self, cfg: Config, db: dict, txn: TxnState, committed,

@@ -281,8 +281,12 @@ def arbitrate_window(txn, active, policy: str, tmp: dict,
         return out
 
     # -- identity-restore the held scratch at every touched row --
+    # hrow has duplicate row ids whenever several S-lock holders share a
+    # row, so the restore must be a commutative combine: .max(BIG_TS) is
+    # order-independent and saturates to the identity (BIG_TS = int32
+    # max), where a duplicate-index .set applies in unspecified order
     tmp = {**tmp,
-           "lk_held": lk_held.at[hrow.reshape(-1)].set(BIG_TS, mode="drop")}
+           "lk_held": lk_held.at[hrow.reshape(-1)].max(BIG_TS, mode="drop")}
     return to_BR(grantW), to_BR(waitW), to_BR(abortW), tmp
 
 

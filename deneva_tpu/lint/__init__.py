@@ -1,0 +1,7 @@
+"""Kernel-contract static analyzer: AST hazard rules over kernel regions
+(engine 1) + jaxpr contract verification of every registered CC plugin
+(engine 2).  CLI: ``python -m deneva_tpu.lint [paths]``; see LINT.md for
+the rule catalog."""
+
+from deneva_tpu.lint.cli import run_lint  # noqa: F401
+from deneva_tpu.lint.rules import RULES, Finding, Rule  # noqa: F401

@@ -1,0 +1,5 @@
+import sys
+
+from deneva_tpu.lint.cli import main
+
+sys.exit(main())

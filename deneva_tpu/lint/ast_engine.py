@@ -1,0 +1,489 @@
+"""Engine 1: AST rules over kernel regions.
+
+A *kernel region* is a function the package traces under jit.  Seeds:
+
+- decorated with ``jax.jit`` / ``functools.partial(jax.jit, ...)``;
+- passed by name to a tracing wrapper (``jax.jit``, ``shard_map``,
+  ``lax.cond/while_loop/scan/fori_loop/switch/map``, ``jax.vmap``, ...)
+  anywhere in the same file (covers ``f = shard_map(spmd, ...)``);
+- a CC-plugin or workload kernel hook method (``access``, ``validate``,
+  ``on_commit``, ..., ``apply_commit_entries``);
+- marked explicitly with ``# lint: kernel`` on the ``def`` line or the
+  line above (for kernels only reachable through attributes, e.g. the
+  scheduler's ``tick_fn`` closed over by ``jax.jit(self._tick_fn)``).
+
+Kernel-ness then propagates through the package call graph: helpers a
+kernel calls (``twopl.arbitrate``, ``seg.sort_by``) are kernels too, so
+the whole package is analyzed as one universe, not file by file.
+
+Rules are deliberately syntactic with one-level local dataflow (names
+resolve to their last assignment): precise enough to prove the shipped
+idioms safe (argsort/arange indices, static config branches) without a
+type system.  What cannot be proven must be fixed or justify-suppressed.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from deneva_tpu.lint.rules import Finding
+
+#: CC plugin hooks (cc/base.py) + workload kernel hooks (workloads/base.py):
+#: methods with these names are traced inside the tick.
+KERNEL_HOOKS = frozenset({
+    "access", "validate", "on_commit", "on_abort", "on_start",
+    "on_finalize_entries", "on_prepared_entries", "on_ts_rebase",
+    "home_commit_check", "commit_forward_entries",
+    "commit_fields", "apply_commit_entries", "user_abort",
+})
+
+#: callables whose function-valued arguments are traced
+WRAPPERS = frozenset({
+    "jax.jit", "jit", "shard_map", "jax.experimental.shard_map.shard_map",
+    "deneva_tpu.compat.shard_map", "jax.vmap", "vmap", "jax.checkpoint",
+    "jax.remat", "checkpoint", "remat",
+    "jax.lax.cond", "jax.lax.while_loop", "jax.lax.scan",
+    "jax.lax.fori_loop", "jax.lax.switch", "jax.lax.map",
+    "jax.lax.associative_scan", "jax.eval_shape", "jax.make_jaxpr",
+})
+
+#: .at[idx].OP combines that are order-independent under duplicate indices
+COMMUTATIVE_SCATTERS = frozenset({"add", "max", "min", "mul", "multiply"})
+
+#: value-preserving array-method wrappers to see through when judging an
+#: index expression (multiset of index values unchanged)
+_UNWRAP_METHODS = frozenset({"reshape", "ravel", "flatten", "astype"})
+
+#: constructors whose default dtype follows the x64 flag
+_DTYPE_CTORS = {"arange": 4, "zeros": 2, "ones": 2, "full": 3, "empty": 2}
+
+_DATA_DEP = frozenset({"nonzero", "flatnonzero", "argwhere", "unique"})
+
+_HOST_ROOTS = ("time.", "numpy.random.", "random.")
+_HOST_NAMES = frozenset({"print", "input", "breakpoint", "open"})
+
+#: jax calls that return static metadata (Python values), not tracers
+_STATIC_JAX = frozenset({
+    "jax.numpy.issubdtype", "jax.numpy.iinfo", "jax.numpy.finfo",
+    "jax.numpy.dtype", "jax.numpy.result_type", "jax.numpy.promote_types",
+})
+
+
+def _dotted(node: ast.AST) -> str | None:
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+@dataclass
+class FuncRec:
+    path: str
+    qualname: str        # "Class.meth" / "outer.<locals>.inner"
+    name: str
+    node: ast.AST        # FunctionDef | Lambda
+    in_class: bool
+    top_level: bool
+    calls: set = field(default_factory=set)   # (module|None, bare name)
+    seed: bool = False
+
+
+class FileIndex:
+    """Single-file symbol table: functions, import aliases, jit-entry
+    names, kernel markers."""
+
+    def __init__(self, path: str, source: str, kernel_lines: frozenset[int]):
+        self.path = path
+        self.tree = ast.parse(source, filename=path)
+        self.aliases: dict[str, str] = {}       # local name -> module path
+        self.from_funcs: dict[str, tuple[str, str]] = {}
+        self.funcs: list[FuncRec] = []
+        self.lambda_kernels: list[ast.Lambda] = []
+        self._kernel_lines = kernel_lines
+        self._collect_imports()
+        self._collect_funcs()
+        jit_names = self._collect_jit_entry_names()
+        for f in self.funcs:
+            if f.name in jit_names:
+                f.seed = True
+
+    # -- symbol collection ------------------------------------------------
+    def _collect_imports(self):
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.aliases[a.asname or a.name.split(".")[0]] = \
+                        a.name if a.asname else a.name.split(".")[0]
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    local = a.asname or a.name
+                    full = f"{node.module}.{a.name}"
+                    # module import vs symbol import is undecidable here;
+                    # record both views and let resolution pick
+                    self.aliases[local] = full
+                    self.from_funcs[local] = (node.module, a.name)
+        # canonical jax spellings regardless of import style
+        self.aliases.setdefault("jnp", "jax.numpy")
+        if self.aliases.get("jnp", "").endswith("jax.numpy"):
+            self.aliases["jnp"] = "jax.numpy"
+        if self.aliases.get("lax", "").endswith("jax.lax"):
+            self.aliases["lax"] = "jax.lax"
+
+    def resolve_dotted(self, name: str) -> str:
+        head, _, rest = name.partition(".")
+        root = self.aliases.get(head, head)
+        return f"{root}.{rest}" if rest else root
+
+    def _collect_funcs(self):
+        path = self.path
+
+        def visit(node, prefix, in_class):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qn = f"{prefix}{child.name}"
+                    rec = FuncRec(path=path, qualname=qn, name=child.name,
+                                  node=child, in_class=in_class,
+                                  top_level=(prefix == ""))
+                    rec.seed = self._is_seed(child, in_class)
+                    rec.calls = self._call_edges(child)
+                    self.funcs.append(rec)
+                    visit(child, qn + ".<locals>.", False)
+                elif isinstance(child, ast.ClassDef):
+                    visit(child, f"{prefix}{child.name}.", True)
+                else:
+                    visit(child, prefix, in_class)
+
+        visit(self.tree, "", False)
+
+    def _is_seed(self, node, in_class: bool) -> bool:
+        if in_class and node.name in KERNEL_HOOKS:
+            return True
+        first = min([node.lineno]
+                    + [d.lineno for d in node.decorator_list])
+        if (first in self._kernel_lines
+                or first - 1 in self._kernel_lines):
+            return True
+        for dec in node.decorator_list:
+            d = dec.func if isinstance(dec, ast.Call) else dec
+            name = _dotted(d)
+            if name and self.resolve_dotted(name) in ("jax.jit", "jit"):
+                return True
+            if (isinstance(dec, ast.Call) and name
+                    and self.resolve_dotted(name).endswith("partial")
+                    and dec.args):
+                inner = _dotted(dec.args[0])
+                if inner and self.resolve_dotted(inner) in ("jax.jit", "jit"):
+                    return True
+        return False
+
+    def _collect_jit_entry_names(self) -> set[str]:
+        names: set[str] = set()
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = _dotted(node.func)
+            if not fn or self.resolve_dotted(fn) not in WRAPPERS:
+                continue
+            args = list(node.args)
+            for a in list(args):
+                if isinstance(a, (ast.List, ast.Tuple)):  # lax.switch
+                    args.extend(a.elts)
+            for a in args:
+                if isinstance(a, ast.Name):
+                    names.add(a.id)
+                elif isinstance(a, ast.Lambda):
+                    self.lambda_kernels.append(a)
+        return names
+
+    def _call_edges(self, node) -> set:
+        edges = set()
+        for c in ast.walk(node):
+            if not isinstance(c, ast.Call):
+                continue
+            if isinstance(c.func, ast.Name):
+                n = c.func.id
+                if n in self.from_funcs:
+                    edges.add(self.from_funcs[n])
+                else:
+                    edges.add((None, n))
+            elif isinstance(c.func, ast.Attribute):
+                chain = _dotted(c.func)
+                if chain is None:
+                    edges.add((None, c.func.attr))
+                    continue
+                head = chain.split(".")[0]
+                mod = self.aliases.get(head)
+                if mod and mod.startswith("deneva_tpu"):
+                    edges.add((mod, c.func.attr))
+                else:
+                    edges.add((None, c.func.attr))
+        return edges
+
+
+class KernelIndex:
+    """Cross-file kernel set: seeds + call-graph closure."""
+
+    def __init__(self, files: list[FileIndex]):
+        self.files = files
+        by_bare: dict[str, list[FuncRec]] = {}
+        by_mod: dict[tuple[str, str], list[FuncRec]] = {}
+        for fi in files:
+            mod = _module_path(fi.path)
+            for f in fi.funcs:
+                by_bare.setdefault(f.name, []).append(f)
+                if f.top_level or f.in_class:
+                    by_mod.setdefault((mod, f.name), []).append(f)
+
+        kernel: set[int] = set()
+        work = [f for fi in files for f in fi.funcs if f.seed]
+        while work:
+            f = work.pop()
+            if id(f) in kernel:
+                continue
+            kernel.add(id(f))
+            for mod, name in f.calls:
+                targets = by_mod.get((mod, name), []) if mod \
+                    else by_bare.get(name, [])
+                for t in targets:
+                    if id(t) not in kernel:
+                        work.append(t)
+        self._kernel_ids = kernel
+
+    def is_kernel(self, rec: FuncRec) -> bool:
+        return id(rec) in self._kernel_ids
+
+    def kernel_roots(self, fi: FileIndex) -> list[ast.AST]:
+        """Outermost kernel scopes per file (nested kernels are covered by
+        their parent's subtree walk)."""
+        nodes = [f.node for f in fi.funcs if self.is_kernel(f)]
+        nodes += fi.lambda_kernels
+        spans = [(n.lineno, getattr(n, "end_lineno", n.lineno), n)
+                 for n in nodes]
+        roots = []
+        for lo, hi, n in spans:
+            if not any(o is not n and olo <= lo and hi <= ohi
+                       for olo, ohi, o in spans):
+                roots.append(n)
+        return roots
+
+
+def _module_path(path: str) -> str:
+    """File path -> dotted module path rooted at the package dir."""
+    parts = path.replace("\\", "/").split("/")
+    if "deneva_tpu" in parts:
+        parts = parts[parts.index("deneva_tpu"):]
+    mod = ".".join(parts)
+    for suf in (".py",):
+        if mod.endswith(suf):
+            mod = mod[:-len(suf)]
+    if mod.endswith(".__init__"):
+        mod = mod[:-len(".__init__")]
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# rule checks within one kernel region
+# ---------------------------------------------------------------------------
+
+class _Env:
+    """Last straight-line assignment per local name."""
+
+    def __init__(self, scope: ast.AST):
+        self.vals: dict[str, ast.AST] = {}
+        for node in ast.walk(scope):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                self.vals[node.targets[0].id] = node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None \
+                    and isinstance(node.target, ast.Name):
+                self.vals[node.target.id] = node.value
+
+
+class KernelChecker(ast.NodeVisitor):
+    def __init__(self, fi: FileIndex, scope: ast.AST):
+        self.fi = fi
+        self.env = _Env(scope)
+        self.findings: list[Finding] = []
+
+    # -- shared helpers ---------------------------------------------------
+    def _emit(self, rule: str, node: ast.AST, msg: str):
+        self.findings.append(Finding(
+            rule=rule, path=self.fi.path, line=node.lineno,
+            end_line=getattr(node, "end_lineno", node.lineno), message=msg))
+
+    def _resolved(self, call: ast.Call) -> str | None:
+        name = _dotted(call.func)
+        return self.fi.resolve_dotted(name) if name else None
+
+    def _is_jax_call(self, node: ast.AST, depth: int = 4) -> bool:
+        """Does this expression (expanding local names) contain a call
+        into jax — i.e. plausibly produce a traced array?"""
+        if depth <= 0:
+            return False
+        for c in ast.walk(node):
+            if isinstance(c, ast.Call):
+                r = self._resolved(c)
+                if r and (r.startswith("jax.") or r == "jax") \
+                        and r not in _STATIC_JAX:
+                    return True
+            elif isinstance(c, ast.Name) and c.id in self.env.vals:
+                v = self.env.vals[c.id]
+                # a name bound to a dict literal used in a bool test is a
+                # membership/None check on static keys, not a traced value
+                if isinstance(v, (ast.Dict, ast.DictComp)):
+                    continue
+                if v is not node and self._is_jax_call(v, depth - 1):
+                    return True
+        return False
+
+    def _is_unique_index(self, idx: ast.AST, depth: int = 5) -> bool:
+        """Statically duplicate-free index expression: a scalar constant,
+        a slice, jnp.arange, or jnp.argsort (a permutation), possibly
+        reshaped/cast, possibly via a local name."""
+        if depth <= 0:
+            return False
+        if isinstance(idx, ast.Constant):
+            return True
+        if isinstance(idx, ast.UnaryOp) and isinstance(idx.operand,
+                                                       ast.Constant):
+            return True
+        if isinstance(idx, ast.Slice):
+            return True
+        if isinstance(idx, ast.Tuple):
+            return all(self._is_unique_index(e, depth - 1)
+                       for e in idx.elts)
+        if isinstance(idx, ast.Name):
+            v = self.env.vals.get(idx.id)
+            return v is not None and self._is_unique_index(v, depth - 1)
+        if isinstance(idx, ast.Call):
+            r = self._resolved(idx)
+            if r in ("jax.numpy.arange", "jax.numpy.argsort",
+                     "numpy.arange", "numpy.argsort"):
+                return True
+            if isinstance(idx.func, ast.Attribute) \
+                    and idx.func.attr in _UNWRAP_METHODS:
+                return self._is_unique_index(idx.func.value, depth - 1)
+        return False
+
+    # -- traced control flow ---------------------------------------------
+    def _check_test(self, node, test):
+        # `a and b` / `not a` bool()s each operand separately: check each
+        # so a static member survives next to a traced one (and vice versa)
+        if isinstance(test, ast.BoolOp):
+            for v in test.values:
+                self._check_test(node, v)
+            return
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            self._check_test(node, test.operand)
+            return
+        # `"key" in db` is a static dict-membership check, traced values
+        # never reach bool()
+        if isinstance(test, ast.Compare) \
+                and all(isinstance(op, (ast.In, ast.NotIn))
+                        for op in test.ops) \
+                and isinstance(test.left, ast.Constant):
+            return
+        if self._is_jax_call(test):
+            kind = type(node).__name__.lower()
+            self._emit("TRACED-BRANCH", node,
+                       f"Python `{kind}` on a traced (jnp) expression")
+
+    def visit_If(self, node):
+        self._check_test(node, node.test)
+        self.generic_visit(node)
+
+    def visit_While(self, node):
+        self._check_test(node, node.test)
+        self.generic_visit(node)
+
+    def visit_Assert(self, node):
+        self._check_test(node, node.test)
+        self.generic_visit(node)
+
+    def visit_IfExp(self, node):
+        self._check_test(node, node.test)
+        self.generic_visit(node)
+
+    # -- calls: concretization, shapes, dtypes, host, scatters -----------
+    def visit_Call(self, node):
+        fn = self._resolved(node)
+
+        if isinstance(node.func, ast.Name) \
+                and node.func.id in ("int", "float", "bool") \
+                and len(node.args) == 1 and self._is_jax_call(node.args[0]):
+            self._emit("TRACER-CONCRETIZE", node,
+                       f"{node.func.id}() on a traced expression")
+        if isinstance(node.func, ast.Attribute) and node.func.attr == "item" \
+                and not node.args:
+            self._emit("TRACER-CONCRETIZE", node,
+                       ".item() inside a kernel region forces a sync")
+
+        if fn and fn.startswith(("jax.numpy.", "numpy.")):
+            leaf = fn.rsplit(".", 1)[1]
+            kw = {k.arg for k in node.keywords}
+            if (leaf in _DATA_DEP or (leaf == "where"
+                                      and len(node.args) == 1)) \
+                    and "size" not in kw:
+                self._emit("DATA-DEP-SHAPE", node,
+                           f"{leaf}() without size= has a value-dependent "
+                           "output shape")
+            if leaf in _DTYPE_CTORS and "dtype" not in kw \
+                    and len(node.args) < _DTYPE_CTORS[leaf]:
+                self._emit("IMPLICIT-DTYPE", node,
+                           f"jnp.{leaf}() without an explicit dtype")
+
+        if fn and (fn in _HOST_NAMES or fn.startswith(_HOST_ROOTS)):
+            self._emit("HOST-CALL", node,
+                       f"host-side call `{fn}` runs at trace time, not "
+                       "per tick")
+
+        self._check_scatter(node)
+        self.generic_visit(node)
+
+    def _check_scatter(self, node: ast.Call):
+        f = node.func
+        if not (isinstance(f, ast.Attribute)
+                and isinstance(f.value, ast.Subscript)
+                and isinstance(f.value.value, ast.Attribute)
+                and f.value.value.attr == "at"):
+            return
+        op = f.attr
+        if op in COMMUTATIVE_SCATTERS or op not in ("set", "apply"):
+            return
+        for k in node.keywords:
+            if k.arg == "unique_indices" \
+                    and isinstance(k.value, ast.Constant) \
+                    and k.value.value is True:
+                return
+        idx = f.value.slice
+        if self._is_unique_index(idx):
+            return
+        self._emit("SCATTER-RACE", node,
+                   f".at[...].{op}() with an index not provably "
+                   "duplicate-free: result is order-dependent under "
+                   "duplicates (declare unique_indices=True, use a "
+                   "commutative combine, or suppress with the masking "
+                   "invariant)")
+
+    # nested defs are part of the kernel region: keep walking
+    def visit_FunctionDef(self, node):
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def check_file(fi: FileIndex, index: KernelIndex) -> list[Finding]:
+    out: list[Finding] = []
+    for root in index.kernel_roots(fi):
+        chk = KernelChecker(fi, root)
+        body = root.body if isinstance(root.body, list) else [root.body]
+        for stmt in body:
+            chk.visit(stmt)
+        out.extend(chk.findings)
+    return out

@@ -1,0 +1,120 @@
+"""CLI: ``python -m deneva_tpu.lint [paths] [--format text|json]``.
+
+Exit code = number of unsuppressed findings (capped at 125 so it never
+collides with signal exit codes).  Engine 2 (the jaxpr plugin verifier)
+runs by default when a scanned path lies inside the deneva_tpu package;
+force it on/off with --jaxpr/--no-jaxpr.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+
+from deneva_tpu.lint import ast_engine, suppress
+from deneva_tpu.lint.rules import RULES, Finding
+
+
+def iter_py_files(paths: list[str]) -> list[str]:
+    out = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _dirs, files in os.walk(p):
+                out.extend(os.path.join(root, f) for f in sorted(files)
+                           if f.endswith(".py"))
+        else:
+            out.append(p)
+    return out
+
+
+def run_ast(files: list[str]) -> list[Finding]:
+    indexed = []
+    for path in files:
+        with open(path, encoding="utf-8") as fh:
+            source = fh.read()
+        sup = suppress.scan(path, source)
+        fi = ast_engine.FileIndex(path, source, sup.kernel_lines)
+        indexed.append((fi, sup))
+    kernel_index = ast_engine.KernelIndex([fi for fi, _ in indexed])
+    findings: list[Finding] = []
+    for fi, sup in indexed:
+        findings.extend(
+            suppress.apply(ast_engine.check_file(fi, kernel_index), sup))
+    return findings
+
+
+def run_lint(paths: list[str], jaxpr: bool | None = None) -> list[Finding]:
+    """Library entry point: both engines, all findings (suppressed ones
+    included, marked)."""
+    files = iter_py_files(paths)
+    findings = run_ast(files)
+    if jaxpr is None:
+        jaxpr = any(_inside_package(f) for f in files)
+    if jaxpr:
+        from deneva_tpu.lint import jaxpr_engine
+        findings.extend(jaxpr_engine.verify_all())
+    return findings
+
+
+def _inside_package(path: str) -> bool:
+    parts = os.path.abspath(path).replace("\\", "/").split("/")
+    return "deneva_tpu" in parts
+
+
+def render_text(findings: list[Finding], show_suppressed: bool) -> str:
+    lines = []
+    active = [f for f in findings if not f.suppressed]
+    for f in sorted(active, key=lambda f: (f.path, f.line, f.rule)):
+        rule = RULES.get(f.rule)
+        lines.append(f"{f.location()}: {f.rule}: {f.message}")
+        if rule:
+            lines.append(f"    fix: {rule.fix}")
+    if show_suppressed:
+        for f in sorted((f for f in findings if f.suppressed),
+                        key=lambda f: (f.path, f.line)):
+            lines.append(f"{f.location()}: {f.rule} [suppressed: "
+                         f"{f.suppress_reason}]")
+    n_sup = sum(f.suppressed for f in findings)
+    lines.append(f"{len(active)} finding(s), {n_sup} suppressed")
+    return "\n".join(lines)
+
+
+def render_json(findings: list[Finding]) -> str:
+    return json.dumps({
+        "findings": [dataclasses.asdict(f) for f in findings],
+        "unsuppressed": sum(not f.suppressed for f in findings),
+    }, indent=2)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m deneva_tpu.lint",
+        description="kernel-contract static analyzer (AST rules + jaxpr "
+                    "plugin verifier)")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files or directories (default: the deneva_tpu "
+                         "package)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--show-suppressed", action="store_true")
+    grp = ap.add_mutually_exclusive_group()
+    grp.add_argument("--jaxpr", dest="jaxpr", action="store_true",
+                     default=None, help="force the plugin verifier on")
+    grp.add_argument("--no-jaxpr", dest="jaxpr", action="store_false",
+                     help="AST engine only")
+    args = ap.parse_args(argv)
+
+    paths = args.paths or [os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))]
+    findings = run_lint(paths, jaxpr=args.jaxpr)
+    if args.format == "json":
+        print(render_json(findings))
+    else:
+        print(render_text(findings, args.show_suppressed))
+    return min(sum(not f.suppressed for f in findings), 125)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

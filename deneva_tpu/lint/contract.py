@@ -1,0 +1,108 @@
+"""Abstract-input builders for the cc/base.py KERNEL_CONTRACT.
+
+Materializes each symbolic argument name of a HookSpec as a concrete
+(small) array so jax.make_jaxpr / jax.eval_shape can trace every plugin
+hook without a real engine, plus the output-protocol checkers the jaxpr
+engine asserts against.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deneva_tpu.config import Config
+from deneva_tpu.engine.state import TxnState
+
+#: small-but-representative trace geometry; E = B * R entry lanes
+B, R = 8, 4
+
+
+def make_cfg(alg: str) -> Config:
+    from deneva_tpu.config import CC_ALGS
+    base = alg if alg in CC_ALGS else sorted(CC_ALGS)[0]
+    cfg = Config(cc_alg=base, batch_size=B, synth_table_size=64,
+                 req_per_query=R, query_pool_size=B, warmup_ticks=0)
+    if base != alg:
+        # a test-registered plugin outside the shipped CC_ALGS set (the
+        # verifier traces whatever REGISTRY holds, not just built-ins)
+        object.__setattr__(cfg, "cc_alg", alg)
+    return cfg
+
+
+def arg_builders(cfg: Config) -> dict:
+    i32 = jnp.int32
+    E = B * R
+    return {
+        "txn": lambda: TxnState.empty(B, R),
+        "mask_b": lambda: jnp.zeros(B, dtype=bool),
+        "ts_b": lambda: jnp.zeros(B, dtype=i32),
+        "tick": lambda: jnp.zeros((), dtype=i32),
+        "keys_e": lambda: jnp.zeros(E, dtype=i32),
+        "ts_e": lambda: jnp.zeros(E, dtype=i32),
+        "mask_e": lambda: jnp.zeros(E, dtype=bool),
+    }
+
+
+def build_args(cfg: Config, spec) -> tuple:
+    builders = arg_builders(cfg)
+    return tuple(builders[name]() for name in spec.args)
+
+
+def tree_signature(tree):
+    """Hashable (structure, shapes, dtypes) signature of a pytree of
+    arrays/ShapeDtypeStructs."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    return treedef, tuple((tuple(v.shape), jnp.dtype(v.dtype).name)
+                          for v in leaves)
+
+
+def describe_mismatch(name: str, got, want) -> str:
+    gd, gs = tree_signature(got)
+    wd, ws = tree_signature(want)
+    if gd != wd:
+        return (f"{name}: pytree structure changed "
+                f"(got {gd}, contract {wd})")
+    diffs = [f"leaf {i}: got {g} want {w}"
+             for i, (g, w) in enumerate(zip(gs, ws)) if g != w]
+    return f"{name}: shape/dtype drift — " + "; ".join(diffs)
+
+
+def check_output(kind: str, value, db_sig) -> str | None:
+    """Validate one returned element against its declared kind; returns
+    an error string or None.  ``value`` holds ShapeDtypeStructs (from
+    eval_shape)."""
+    if kind == "db":
+        if not isinstance(value, dict):
+            return f"db: expected dict, got {type(value).__name__}"
+        if tree_signature(value) != db_sig:
+            return describe_mismatch("db", value,
+                                     _sig_placeholder(db_sig))
+        return None
+    if kind == "decision":
+        leaves = jax.tree_util.tree_leaves(value)
+        if len(leaves) != 3:
+            return (f"decision: expected 3 (B, R) masks "
+                    f"(grant, wait, abort), got {len(leaves)} leaves")
+        for nm, v in zip(("grant", "wait", "abort"), leaves):
+            if tuple(v.shape) != (B, R) or jnp.dtype(v.dtype) != bool:
+                return (f"decision.{nm}: want (B, R)=({B}, {R}) bool, "
+                        f"got {tuple(v.shape)} {jnp.dtype(v.dtype).name}")
+        return None
+    if kind == "votes":
+        if tuple(value.shape) != (B,) or jnp.dtype(value.dtype) != bool:
+            return (f"votes: want ({B},) bool, got {tuple(value.shape)} "
+                    f"{jnp.dtype(value.dtype).name}")
+        return None
+    raise ValueError(kind)  # unknown contract kind: a bug here, not there
+
+
+class _SigTree:
+    pass
+
+
+def _sig_placeholder(sig):
+    """Reconstruct a displayable pytree from a signature for error text."""
+    treedef, leaves = sig
+    structs = [jax.ShapeDtypeStruct(s, d) for s, d in leaves]
+    return jax.tree_util.tree_unflatten(treedef, structs)

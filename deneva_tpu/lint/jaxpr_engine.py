@@ -1,0 +1,166 @@
+"""Engine 2: jaxpr contract verifier for CC plugins.
+
+Imports every registered plugin, abstract-evals each hook declared in
+cc/base.py KERNEL_CONTRACT via jax.make_jaxpr on small abstract inputs,
+and asserts:
+
+- the output obeys the declared protocol (db pytree structure / shapes /
+  dtypes unchanged; decision = 3x (B, R) bool; votes = (B,) bool);
+- the jaxpr contains no callback/debug/infeed primitives at any depth;
+- every scan/while carry is structure-stable (body in == body out);
+- no closure captures a concrete array above a size threshold (HBM
+  constant bloat invisible to donation).
+
+Pure import-and-trace: no engine, no device state, runs in CI on CPU.
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+
+import jax
+import numpy as np
+
+from deneva_tpu.lint import contract
+from deneva_tpu.lint.rules import Finding
+
+#: host round-trip primitives forbidden inside shipped plugin hooks
+CALLBACK_PRIMS = frozenset({
+    "pure_callback", "io_callback", "debug_callback", "host_callback_call",
+    "outside_call", "infeed", "outfeed", "debug_print",
+})
+
+#: max elements a closed-over constant may hold before it counts as
+#: baked-in HBM state (one (B, R) lane block at trace geometry is 32)
+CONST_ELEMS_MAX = 16384
+
+
+def _sub_jaxprs(params: dict):
+    for v in params.values():
+        vs = v if isinstance(v, (tuple, list)) else (v,)
+        for x in vs:
+            if hasattr(x, "jaxpr") and hasattr(x, "consts"):  # ClosedJaxpr
+                yield x.jaxpr, x.consts
+            elif hasattr(x, "eqns"):                          # raw Jaxpr
+                yield x, ()
+
+
+def _walk(jaxpr, consts, visit_eqn, visit_consts):
+    visit_consts(consts)
+    for eqn in jaxpr.eqns:
+        visit_eqn(eqn)
+        for sub, sub_consts in _sub_jaxprs(eqn.params):
+            _walk(sub, sub_consts, visit_eqn, visit_consts)
+
+
+def _carry_error(eqn) -> str | None:
+    name = eqn.primitive.name
+    if name == "scan":
+        body = eqn.params["jaxpr"].jaxpr
+        nc, ncarry = eqn.params["num_consts"], eqn.params["num_carry"]
+        ins = [v.aval for v in body.invars[nc:nc + ncarry]]
+        outs = [v.aval for v in body.outvars[:ncarry]]
+    elif name == "while":
+        body = eqn.params["body_jaxpr"].jaxpr
+        nc = eqn.params["body_nconsts"]
+        ins = [v.aval for v in body.invars[nc:]]
+        outs = [v.aval for v in body.outvars]
+    else:
+        return None
+    if [(i.shape, i.dtype) for i in ins] != \
+            [(o.shape, o.dtype) for o in outs]:
+        return (f"{name} carry drifts: in "
+                f"{[(tuple(i.shape), str(i.dtype)) for i in ins]} vs out "
+                f"{[(tuple(o.shape), str(o.dtype)) for o in outs]}")
+    return None
+
+
+def _hook_anchor(plugin, hook: str) -> tuple[str, int]:
+    fn = getattr(type(plugin), hook, None)
+    try:
+        path = inspect.getsourcefile(fn)
+        line = inspect.getsourcelines(fn)[1]
+        return path or f"<plugin:{plugin.name}>", line
+    except (TypeError, OSError):
+        return f"<plugin:{plugin.name}>", 0
+
+
+def verify_plugin(alg: str) -> list[Finding]:
+    from deneva_tpu import cc
+    from deneva_tpu.cc.base import KERNEL_CONTRACT
+
+    plugin = cc.get(alg)
+    cfg = contract.make_cfg(alg)
+    db = plugin.init_db(cfg, n_rows=64, B=contract.B, R=contract.R)
+    db_sig = contract.tree_signature(db)
+    findings: list[Finding] = []
+
+    for hook, spec in KERNEL_CONTRACT.items():
+        path, line = _hook_anchor(plugin, hook)
+
+        def emit(rule, msg):
+            findings.append(Finding(
+                rule=rule, path=path, line=line,
+                message=f"[{alg}.{hook}] {msg}"))
+
+        args = contract.build_args(cfg, spec)
+        bound = functools.partial(getattr(plugin, hook), cfg)
+        try:
+            closed, out_shape = jax.make_jaxpr(
+                bound, return_shape=True)(db, *args)
+        except Exception as e:  # noqa: BLE001 — any trace failure is the finding
+            emit("CONTRACT-TRACE",
+                 f"failed to abstract-eval: {type(e).__name__}: {e}")
+            continue
+
+        # -- output protocol --
+        outs = (out_shape,) if len(spec.returns) == 1 else tuple(out_shape)
+        if len(outs) != len(spec.returns):
+            emit("CONTRACT-STRUCT",
+                 f"returns {len(outs)} values, contract declares "
+                 f"{len(spec.returns)} {spec.returns}")
+        else:
+            for kind, val in zip(spec.returns, outs):
+                err = contract.check_output(kind, val, db_sig)
+                if err:
+                    emit("CONTRACT-STRUCT", err)
+
+        # -- jaxpr walk: callbacks, carries, big consts --
+        seen_cb: set[str] = set()
+        carry_errs: list[str] = []
+        const_bytes: list[str] = []
+
+        def visit_eqn(eqn):
+            nm = eqn.primitive.name
+            if nm in CALLBACK_PRIMS and nm not in seen_cb:
+                seen_cb.add(nm)
+            err = _carry_error(eqn)
+            if err:
+                carry_errs.append(err)
+
+        def visit_consts(consts):
+            for c in consts:
+                if isinstance(c, (np.ndarray, jax.Array)) \
+                        and c.size > CONST_ELEMS_MAX:
+                    const_bytes.append(
+                        f"{tuple(c.shape)} {c.dtype} ({c.size} elems)")
+
+        _walk(closed.jaxpr, closed.consts, visit_eqn, visit_consts)
+        for nm in sorted(seen_cb):
+            emit("CONTRACT-CALLBACK", f"jaxpr contains `{nm}`")
+        for err in carry_errs:
+            emit("CONTRACT-CARRY", err)
+        for desc in const_bytes:
+            emit("CONTRACT-CONST",
+                 f"closure bakes a {desc} constant into the jaxpr "
+                 f"(> {CONST_ELEMS_MAX} elems)")
+    return findings
+
+
+def verify_all(algs=None) -> list[Finding]:
+    from deneva_tpu import cc
+    out: list[Finding] = []
+    for alg in sorted(algs if algs is not None else cc.REGISTRY):
+        out.extend(verify_plugin(alg))
+    return out

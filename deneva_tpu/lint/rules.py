@@ -1,0 +1,163 @@
+"""Rule catalog and finding record for the kernel-contract analyzer.
+
+Every rule the two engines can emit lives here so the CLI, the docs
+(LINT.md) and the tests share one registry.  AST rules fire on source
+patterns inside *kernel regions* (see ast_engine.KernelIndex); CONTRACT
+rules fire from abstract evaluation of CC plugin hooks (jaxpr_engine).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    id: str
+    title: str
+    rationale: str
+    fix: str
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str          # file path ("<plugin:NAME>" for jaxpr findings)
+    line: int          # 1-based; 0 when no source anchor exists
+    message: str
+    end_line: int = 0  # last physical line of the offending statement
+    suppressed: bool = False
+    suppress_reason: str = ""
+
+    def __post_init__(self):
+        if self.end_line < self.line:
+            self.end_line = self.line
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}"
+
+
+_ALL = [
+    Rule(
+        id="TRACED-BRANCH",
+        title="Python control flow on a traced value",
+        rationale="`if`/`while`/`assert` on a jnp expression calls bool() "
+                  "on a tracer: TracerBoolConversionError under jit, or a "
+                  "silent retrace per value under eager checks.",
+        fix="Use jnp.where / lax.cond / lax.select, or hoist the branch to "
+            "a static config value.",
+    ),
+    Rule(
+        id="TRACER-CONCRETIZE",
+        title="Concretizing a traced value",
+        rationale=".item()/int()/float()/bool() on a tracer forces a "
+                  "device sync or fails under jit; kernels must stay "
+                  "abstract end to end.",
+        fix="Keep the value as a 0-d array; concretize only outside the "
+            "jit boundary (e.g. in summary()/host code).",
+    ),
+    Rule(
+        id="DATA-DEP-SHAPE",
+        title="Data-dependent output shape",
+        rationale="jnp.nonzero/flatnonzero/argwhere/unique and 1-arg "
+                  "jnp.where produce shapes that depend on values — a "
+                  "recompile per distinct count, or a trace error.",
+        fix="Pass size= (with fill_value) to fix the output shape, or "
+            "restructure as a masked dense computation.",
+    ),
+    Rule(
+        id="IMPLICIT-DTYPE",
+        title="Array constructor without explicit dtype",
+        rationale="jnp.arange/zeros/ones/full/empty default dtype follows "
+                  "the x64 flag; timestamp arithmetic silently widens or "
+                  "wraps differently across configs (int32 overflow "
+                  "hazard at the 2**31 ts rebase boundary).",
+        fix="Pass dtype=jnp.int32 (or the intended dtype) explicitly.",
+    ),
+    Rule(
+        id="HOST-CALL",
+        title="Host-side call inside a kernel region",
+        rationale="print/time.time/np.random/file I/O execute at trace "
+                  "time, not per tick: they run once at compile and "
+                  "never again, and their results bake into the jaxpr "
+                  "as constants.",
+        fix="Move host effects outside the jit boundary; use jax.debug."
+            "print only for temporary debugging (the contract verifier "
+            "rejects it in shipped plugin hooks); draw randomness via "
+            "jax.random with an explicit key.",
+    ),
+    Rule(
+        id="SCATTER-RACE",
+        title="Order-dependent duplicate-index scatter",
+        rationale="`.at[idx].set/apply` with duplicate indices applies in "
+                  "unspecified order — the batched-CC data race (the MaaT "
+                  "wraparound bug class).  Commutative combines "
+                  "(.add/.max/.min/.mul) are order-independent; `.set` is "
+                  "only safe when idx is provably duplicate-free.",
+        fix="Declare uniqueness with unique_indices=True (dead lanes must "
+            "then map to DISTINCT out-of-bounds indices, e.g. "
+            "`sentinel + arange(n)` with mode='drop'), switch to a "
+            "commutative combine, or mask to one winner per index and "
+            "suppress with the invariant spelled out.",
+    ),
+    Rule(
+        id="SUPPRESS-NO-REASON",
+        title="Suppression without a justification",
+        rationale="`# lint: disable=RULE` must record WHY the finding is "
+                  "safe; an unjustified suppression hides a real hazard "
+                  "from the next reader.",
+        fix="Append the invariant that makes the pattern safe: "
+            "`# lint: disable=RULE <reason>`.",
+    ),
+    Rule(
+        id="CONTRACT-TRACE",
+        title="Plugin hook failed abstract evaluation",
+        rationale="Every CC hook must trace under jax.make_jaxpr with the "
+                  "declared abstract inputs; a hook that only works on "
+                  "concrete arrays is not a jit-safe kernel.",
+        fix="Remove value-dependent Python control flow / concretization "
+            "from the hook (see the chained exception).",
+    ),
+    Rule(
+        id="CONTRACT-STRUCT",
+        title="Hook output violates the declared contract",
+        rationale="The engine zips plugin outputs positionally into the "
+                  "tick state; a changed db pytree structure, shape or "
+                  "dtype corrupts state silently or breaks donation.",
+        fix="Return the db dict with the same keys/shapes/dtypes it "
+            "received; decision masks are (B, R) bool, votes (B,) bool.",
+    ),
+    Rule(
+        id="CONTRACT-CALLBACK",
+        title="Callback/debug primitive in a plugin hook jaxpr",
+        rationale="pure_callback/io_callback/debug_callback reintroduce "
+                  "host round-trips into the tick — the reference's "
+                  "per-row mutex critical sections we tensorized away.",
+        fix="Delete the callback; keep debugging prints behind a config "
+            "flag outside the shipped hook.",
+    ),
+    Rule(
+        id="CONTRACT-CARRY",
+        title="Loop carry not structure-stable",
+        rationale="scan/while bodies must map the carry type to itself; "
+                  "a drifting carry means a recompile or trace error at "
+                  "a larger batch.",
+        fix="Keep the carry pytree/shapes/dtypes identical across one "
+            "body application.",
+    ),
+    Rule(
+        id="CONTRACT-CONST",
+        title="Large concrete array baked into a hook closure",
+        rationale="A hook closing over a big device array turns it into "
+                  "an XLA constant: silent HBM bloat duplicated per "
+                  "compiled executable, invisible to donation.",
+        fix="Thread the array through db/arguments instead of closing "
+            "over it.",
+    ),
+]
+
+RULES: dict[str, Rule] = {r.id: r for r in _ALL}
+
+#: rules that may never be suppressed (suppressing a missing reason with
+#: another bare suppression would recurse)
+UNSUPPRESSABLE = frozenset({"SUPPRESS-NO-REASON"})

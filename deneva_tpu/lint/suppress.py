@@ -1,0 +1,97 @@
+"""Inline suppression comments.
+
+Syntax, anywhere on a physical line of the offending statement:
+
+    # lint: disable=RULE-ID reason why this is safe
+    # lint: disable=RULE-A,RULE-B shared reason
+
+or, on its own line immediately above the offending statement (skipping
+blank/comment lines), when the inline form would overflow the line:
+
+    # lint: disable-next=RULE-ID reason why this is safe
+
+A finding is suppressed when any line in its statement span carries a
+matching disable comment.  A disable comment with no reason text is
+itself a finding (SUPPRESS-NO-REASON): suppressions are recorded
+invariants, not mute buttons.
+
+The adjacent `# lint: kernel` marker (see ast_engine) is parsed here too
+so both live in one grep-able grammar.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+from deneva_tpu.lint.rules import Finding, UNSUPPRESSABLE
+
+_DISABLE = re.compile(
+    r"#\s*lint:\s*disable(-next)?=([A-Za-z0-9_-]+(?:\s*,\s*[A-Za-z0-9_-]+)*)"
+    r"[ \t]*(.*)$")
+_KERNEL = re.compile(r"#\s*lint:\s*kernel\b")
+
+
+@dataclass
+class Suppressions:
+    """Per-file map of line -> (rule ids, reason) plus kernel markers."""
+
+    by_line: dict[int, tuple[frozenset[str], str]] = field(
+        default_factory=dict)
+    kernel_lines: frozenset[int] = frozenset()
+    bare: list[Finding] = field(default_factory=list)
+
+    def match(self, finding: Finding) -> tuple[bool, str]:
+        """(suppressed?, reason) for a finding spanning
+        [finding.line, finding.end_line]."""
+        if finding.rule in UNSUPPRESSABLE:
+            return False, ""
+        for ln in range(finding.line, finding.end_line + 1):
+            hit = self.by_line.get(ln)
+            if hit and finding.rule in hit[0]:
+                return True, hit[1]
+        return False, ""
+
+
+def scan(path: str, source: str) -> Suppressions:
+    out = Suppressions()
+    kernel = set()
+    lines = source.splitlines()
+    for i, text in enumerate(lines, start=1):
+        if _KERNEL.search(text):
+            kernel.add(i)
+        m = _DISABLE.search(text)
+        if not m:
+            continue
+        ids = frozenset(p.strip() for p in m.group(2).split(","))
+        reason = m.group(3).strip()
+        target = i
+        if m.group(1):  # disable-next: anchor at the next code line
+            target = i + 1
+            while target <= len(lines) and (
+                    not lines[target - 1].strip()
+                    or lines[target - 1].lstrip().startswith("#")):
+                target += 1
+        prev_ids, prev_reason = out.by_line.get(target, (frozenset(), ""))
+        out.by_line[target] = (prev_ids | ids,
+                               "; ".join(x for x in (prev_reason, reason)
+                                         if x))
+        if not reason:
+            out.bare.append(Finding(
+                rule="SUPPRESS-NO-REASON", path=path, line=i,
+                message=f"suppression of {', '.join(sorted(ids))} "
+                        "gives no reason"))
+    out.kernel_lines = frozenset(kernel)
+    return out
+
+
+def apply(findings: list[Finding], sup: Suppressions) -> list[Finding]:
+    """Mark suppressed findings in place; returns the same list with the
+    bare-suppression findings appended."""
+    for f in findings:
+        hit, reason = sup.match(f)
+        if hit:
+            f.suppressed = True
+            f.suppress_reason = reason
+    findings.extend(sup.bare)
+    return findings

@@ -33,7 +33,7 @@ def sort_by(keys: tuple[jnp.ndarray, ...], payload: tuple[jnp.ndarray, ...]):
 def segment_starts(sorted_ids: jnp.ndarray) -> jnp.ndarray:
     """Boolean mask marking the first element of each equal-id run."""
     n = sorted_ids.shape[0]
-    idx = jnp.arange(n)
+    idx = jnp.arange(n, dtype=jnp.int32)
     return jnp.where(idx == 0, True, sorted_ids != jnp.roll(sorted_ids, 1))
 
 

@@ -44,19 +44,25 @@ def pack_by_dest(dest: jnp.ndarray, prio: jnp.ndarray, live: jnp.ndarray,
     starts = seg.segment_starts(sd)
     pos = seg.pos_in_segment(starts)
     kept = (sd < n_nodes) & (pos < cap)
-    slot = jnp.where(kept, sd * cap + pos, n_nodes * cap)
+    # kept slots are distinct by construction (pos < cap within each dest
+    # segment); unrouted lanes map to DISTINCT out-of-bounds cells so the
+    # scatters below are globally duplicate-free (unique_indices=True)
+    slot = jnp.where(kept, sd * cap + pos, n_nodes * cap + idx)
 
     send = {}
     for name, vals in fields.items():
         fill = FILL.get(name, 0)
         buf = jnp.full(n_nodes * cap, fill, vals.dtype)
-        send[name] = buf.at[slot].set(vals[sidx], mode="drop").reshape(
+        send[name] = buf.at[slot].set(vals[sidx], mode="drop",
+                                      unique_indices=True).reshape(
             n_nodes, cap)
     orig = jnp.full(n_nodes * cap, -1, jnp.int32).at[slot].set(
-        sidx, mode="drop").reshape(n_nodes, cap)
+        sidx, mode="drop", unique_indices=True).reshape(n_nodes, cap)
 
     ovf_sorted = (sd < n_nodes) & (pos >= cap)
-    overflow = jnp.zeros(n, dtype=bool).at[sidx].set(ovf_sorted)
+    # sidx is the sort payload of arange(n): a permutation, hence unique
+    overflow = jnp.zeros(n, dtype=bool).at[sidx].set(ovf_sorted,
+                                                     unique_indices=True)
     return send, orig, overflow
 
 
@@ -74,8 +80,14 @@ def unpack(results: dict[str, jnp.ndarray], orig: jnp.ndarray, n: int,
     order using the packing permutation.  `defaults` provides the value for
     entries that were never shipped (overflow / dead)."""
     flat_orig = orig.reshape(-1)
-    tgt = jnp.where(flat_orig >= 0, flat_orig, n)
+    # live orig entries are distinct (each entry packs into at most one
+    # lane); empty lanes map to DISTINCT cells past the (n+1)-sized
+    # defaults so they are dropped instead of racing on the junk slot n
+    m = flat_orig.shape[0]
+    tgt = jnp.where(flat_orig >= 0, flat_orig,
+                    n + 1 + jnp.arange(m, dtype=jnp.int32))
     out = {}
     for name, buf in results.items():
-        out[name] = defaults[name].at[tgt].set(buf.reshape(-1), mode="drop")
+        out[name] = defaults[name].at[tgt].set(buf.reshape(-1), mode="drop",
+                                               unique_indices=True)
     return out

@@ -47,7 +47,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from deneva_tpu.compat import shard_map
 
 from deneva_tpu import cc as cc_registry
 from deneva_tpu import workloads as wl_registry
@@ -744,15 +744,22 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
                     rlive = rrecs != NULL_KEY
                 rrank = jnp.cumsum(rlive.astype(jnp.int32)) - rlive.astype(
                     jnp.int32)
-                rpos2 = jnp.where(rlive,
+                n_r = jnp.sum(rlive.astype(jnp.int32))
+                # ring discipline as in append_log_ring: keep the last
+                # log_buf_cap records (distinct in-ring positions); dead
+                # lanes get DISTINCT out-of-bounds cells
+                rkeep = rlive & (rrank >= n_r - cfg.log_buf_cap)
+                rpos2 = jnp.where(rkeep,
                                   (stats["repl_lsn"] + rrank)
                                   % cfg.log_buf_cap,
-                                  cfg.log_buf_cap)
-                repl_lsn2 = stats["repl_lsn"] \
-                    + jnp.sum(rlive.astype(jnp.int32))
+                                  cfg.log_buf_cap
+                                  + jnp.arange(rlive.shape[0],
+                                               dtype=jnp.int32))
+                repl_lsn2 = stats["repl_lsn"] + n_r
                 stats = {**stats,
                          "arr_repl_key": stats["arr_repl_key"].at[
-                             rpos2].set(rrecs, mode="drop"),
+                             rpos2].set(rrecs, mode="drop",
+                                        unique_indices=True),
                          "repl_lsn": repl_lsn2}
                 if cfg.repl_mode == "ap":
                     # the replica acks its new high-water mark; the worker
@@ -764,8 +771,11 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
                         ring = stats["arr_repl_ackring"]
                         idx = t % cfg.repl_lag_ticks
                         acked = ring[idx]
+                        # lint: disable-next=SCATTER-RACE single 0-d write
+                        # (a scalar index cannot carry duplicates)
+                        ring = ring.at[idx].set(ack)
                         stats = {**stats,
-                                 "arr_repl_ackring": ring.at[idx].set(ack),
+                                 "arr_repl_ackring": ring,
                                  "repl_acked_lsn": acked}
                     else:
                         stats = {**stats, "repl_acked_lsn": ack}

@@ -279,10 +279,18 @@ class PPSWorkload(WorkloadPlugin):
         idx = jnp.arange(n, dtype=jnp.int32)
         (sk, _), (sidx,) = seg.sort_by((skey, cts), (idx,))
         is_last = (jnp.roll(sk, -1) != sk).at[-1].set(True)
-        last = jnp.zeros(n, dtype=bool).at[sidx].set(is_last)
+        # sidx is the sort payload of arange(n): a permutation, so unique
+        last = jnp.zeros(n, dtype=bool).at[sidx].set(is_last,
+                                                     unique_indices=True)
         winner = m_set & last
-        t["uses_part"] = t["uses_part"].at[off("USES", winner)].set(
-            jnp.where(winner, earg, 0), mode="drop")
+        # one winner (max cts sorts last) per USES row -> live offsets are
+        # distinct; dead lanes map to DISTINCT out-of-bounds cells (the
+        # shared OOB sentinel would be a duplicate index)
+        nU = t["uses_part"].shape[0]
+        u_idx = jnp.where(winner, key_local - cat.tables["USES"].base,
+                          nU + idx)
+        t["uses_part"] = t["uses_part"].at[u_idx].set(
+            jnp.where(winner, earg, 0), mode="drop", unique_indices=True)
         return t
 
     def user_abort(self, cfg: Config, txn, finishing):

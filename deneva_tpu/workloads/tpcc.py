@@ -436,7 +436,9 @@ class TPCCWorkload(WorkloadPlugin):
         slot = jnp.arange(B, dtype=jnp.int32)
         (sd, _), (sidx,) = seg.sort_by((dkey, slot), (slot,))
         rank_sorted = seg.pos_in_segment(seg.segment_starts(sd))
-        rank = jnp.zeros(B, jnp.int32).at[sidx].set(rank_sorted)
+        # sidx is the sort payload of arange(B): a permutation, so unique
+        rank = jnp.zeros(B, jnp.int32).at[sidx].set(rank_sorted,
+                                                    unique_indices=True)
         d_next = tables["d_next_o_id"][jnp.where(is_no, dloc, 0)]
         o_id = jnp.where(is_no, d_next + rank, 0)
 
@@ -591,8 +593,15 @@ class TPCCWorkload(WorkloadPlugin):
         _, qa = jax.lax.while_loop(lambda c: c[0] <= max_rank, body,
                                    (jnp.int32(0), sq0))
         ends = jnp.roll(sstarts, -1).at[-1].set(True)
-        t["s_quantity"] = t["s_quantity"].at[
-            jnp.where(slive & ends, soff, OOB)].set(qa, mode="drop")
+        # one end per sorted stock-key segment -> live soff are distinct;
+        # dead lanes map to DISTINCT out-of-bounds cells (nSQ + k) rather
+        # than a shared sentinel so unique_indices=True holds globally
+        # (int32-max would overflow to negative, in-bounds, indices)
+        nSQ = t["s_quantity"].shape[0]
+        sq_idx = jnp.where(slive & ends, soff,
+                           nSQ + jnp.arange(soff.shape[0], dtype=jnp.int32))
+        t["s_quantity"] = t["s_quantity"].at[sq_idx].set(
+            qa, mode="drop", unique_indices=True)
 
         # -- ring appends (deterministic: ordered by (cts, entry index));
         # one (n, C) row scatter per ring block --
@@ -600,12 +609,20 @@ class TPCCWorkload(WorkloadPlugin):
             cnt = jnp.sum(mask.astype(jnp.int32))
             pri = jnp.where(mask, cts, OOB)
             (pk, _), (pidx,) = seg.sort_by((pri, idx), (idx,))
+            # pidx is a sort permutation of arange(n): unique indices
             r = jnp.zeros(n, jnp.int32).at[pidx].set(
-                jnp.arange(n, dtype=jnp.int32))
-            pos = jnp.where(mask, (t[cursor_key] + r) % cap, cap)
+                jnp.arange(n, dtype=jnp.int32), unique_indices=True)
+            # masked lanes sort first, so their ranks are 0..cnt-1; ring
+            # discipline under wrap keeps the LAST cap records (distinct
+            # in-ring positions) and dead lanes take DISTINCT
+            # out-of-bounds cells
+            live = mask & (r >= cnt - cap)
+            pos = jnp.where(live, (t[cursor_key] + r) % cap,
+                            cap + jnp.arange(n, dtype=jnp.int32))
             payload = jnp.stack([jnp.where(mask, v, 0) for v in cols],
                                 axis=1)
-            t[block_key] = t[block_key].at[pos].set(payload, mode="drop")
+            t[block_key] = t[block_key].at[pos].set(payload, mode="drop",
+                                                    unique_indices=True)
             t[cursor_key] = t[cursor_key] + cnt
 
         # HISTORY at the customer's shard (run_payment_5: insert at

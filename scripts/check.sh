@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# CI gate: static analysis first, then the tier-1 test suite.
+# Fails on either.  Run from the repo root: scripts/check.sh
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== deneva_tpu.lint =="
+env JAX_PLATFORMS=cpu python -m deneva_tpu.lint deneva_tpu
+lint_rc=$?
+if [ "$lint_rc" -ne 0 ]; then
+    echo "lint FAILED (rc=$lint_rc)"
+    exit "$lint_rc"
+fi
+
+echo "== tier-1 pytest =="
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
+    | tr -cd . | wc -c)
+exit "$rc"

@@ -17,3 +17,8 @@ import jax  # noqa: E402
 
 # the env var alone does not beat the preinstalled tpu plugin's priority
 jax.config.update("jax_platforms", "cpu")
+
+# NOTE: do not enable the persistent compilation cache
+# (jax_compilation_cache_dir) here: on this jaxlib (0.4.37 CPU) reloading
+# a cached tick executable aborts the process (native CHECK failure in
+# deserialization) partway through the suite.

@@ -49,6 +49,9 @@ def test_equivalence_read_heavy_wait_die():
     assert (d1 == d2).all()
 
 
+# Unlocked by the shard_map compat fix (failed at the seed); exceeds
+# the tier-1 time budget -- run with `-m slow`.
+@pytest.mark.slow
 @pytest.mark.parametrize("alg", ["NO_WAIT", "WAIT_DIE", "CALVIN"])
 def test_sharded_equivalence(alg):
     from deneva_tpu.parallel.sharded import ShardedEngine

@@ -1,0 +1,415 @@
+"""Static analyzer tests: per-rule fixture snippets for the AST engine,
+the suppression grammar, the CLI contract (exit code / JSON), the jaxpr
+plugin verifier (accepts all shipped plugins, rejects a broken one), the
+self-lint gate, and the scatter-race regression that motivated the
+SCATTER-RACE rule (twopl's identity-restore of the held-lock scratch).
+"""
+
+import json
+import subprocess
+import sys
+import textwrap
+
+import jax.numpy as jnp
+import pytest
+
+from deneva_tpu.lint import run_lint
+
+pytestmark = pytest.mark.lint
+
+
+# ---------------------------------------------------------------------------
+# helpers
+
+def lint_src(tmp_path, code, name="snippet.py"):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(code))
+    return run_lint([str(p)], jaxpr=False)
+
+
+def active_rules(findings):
+    return sorted({f.rule for f in findings if not f.suppressed})
+
+
+# ---------------------------------------------------------------------------
+# AST rules: each has a bad fixture (flagged) and a good one (clean)
+
+BAD_TRACED_BRANCH = """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def step(x):
+        if jnp.sum(x) > 0:
+            return x + 1
+        return x
+"""
+
+GOOD_TRACED_BRANCH = """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def step(x):
+        return jnp.where(jnp.sum(x) > 0, x + 1, x)
+"""
+
+BAD_CONCRETIZE = """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def step(x):
+        n = int(jnp.sum(x))
+        return x[:1] * n
+"""
+
+BAD_ITEM = """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def step(x):
+        return x * jnp.max(x).item()
+"""
+
+BAD_DATA_DEP = """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def step(x):
+        idx = jnp.nonzero(x > 0)[0]
+        return x[idx]
+"""
+
+GOOD_DATA_DEP = """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def step(x):
+        idx = jnp.nonzero(x > 0, size=4, fill_value=0)[0]
+        return x[idx]
+"""
+
+BAD_DTYPE = """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def step(x):
+        return x + jnp.arange(8)
+"""
+
+GOOD_DTYPE = """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def step(x):
+        return x + jnp.arange(8, dtype=jnp.int32)
+"""
+
+BAD_HOST = """
+    import time
+    import jax
+
+    @jax.jit
+    def step(x):
+        print("tick at", time.time())
+        return x
+"""
+
+GOOD_HOST = """
+    import time
+
+    def driver(x):
+        # host code outside any kernel region: host calls are fine
+        print("tick at", time.time())
+        return x
+"""
+
+BAD_SCATTER = """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def step(db, keys, vals):
+        return db.at[keys].set(vals, mode="drop")
+"""
+
+GOOD_SCATTER_ADD = """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def step(db, keys, vals):
+        return db.at[keys].add(vals, mode="drop")
+"""
+
+GOOD_SCATTER_UNIQUE = """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def step(db, keys, vals):
+        return db.at[keys].set(vals, mode="drop", unique_indices=True)
+"""
+
+GOOD_SCATTER_ARANGE = """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def step(db, vals):
+        return db.at[jnp.arange(8, dtype=jnp.int32)].set(vals)
+"""
+
+
+@pytest.mark.parametrize("code,rule", [
+    (BAD_TRACED_BRANCH, "TRACED-BRANCH"),
+    (BAD_CONCRETIZE, "TRACER-CONCRETIZE"),
+    (BAD_ITEM, "TRACER-CONCRETIZE"),
+    (BAD_DATA_DEP, "DATA-DEP-SHAPE"),
+    (BAD_DTYPE, "IMPLICIT-DTYPE"),
+    (BAD_HOST, "HOST-CALL"),
+    (BAD_SCATTER, "SCATTER-RACE"),
+], ids=["traced-branch", "concretize-int", "concretize-item", "data-dep",
+        "implicit-dtype", "host-call", "scatter-race"])
+def test_bad_fixture_is_flagged(tmp_path, code, rule):
+    assert rule in active_rules(lint_src(tmp_path, code))
+
+
+@pytest.mark.parametrize("code", [
+    GOOD_TRACED_BRANCH, GOOD_DATA_DEP, GOOD_DTYPE, GOOD_HOST,
+    GOOD_SCATTER_ADD, GOOD_SCATTER_UNIQUE, GOOD_SCATTER_ARANGE,
+], ids=["where", "sized-nonzero", "explicit-dtype", "host-outside-kernel",
+        "commutative-add", "declared-unique", "arange-index"])
+def test_good_fixture_is_clean(tmp_path, code):
+    assert active_rules(lint_src(tmp_path, code)) == []
+
+
+def test_rules_only_apply_inside_kernel_regions(tmp_path):
+    # the same hazards in plain host code are not findings
+    code = """
+        import jax.numpy as jnp
+
+        def host_helper(x):
+            if jnp.sum(x) > 0:
+                return int(jnp.sum(x))
+            return 0
+    """
+    assert active_rules(lint_src(tmp_path, code)) == []
+
+
+def test_kernel_marker_promotes_function(tmp_path):
+    # no decorator the seed scan could find, only the explicit marker
+    code = """
+        import jax.numpy as jnp
+
+        # lint: kernel
+        def step(x):
+            if jnp.sum(x) > 0:
+                return x + 1
+            return x
+    """
+    assert "TRACED-BRANCH" in active_rules(lint_src(tmp_path, code))
+
+
+def test_kernelness_propagates_through_calls(tmp_path):
+    # helper is only hazardous because a jitted caller reaches it
+    code = """
+        import jax
+        import jax.numpy as jnp
+
+        def helper(x):
+            if jnp.sum(x) > 0:
+                return x + 1
+            return x
+
+        @jax.jit
+        def step(x):
+            return helper(x)
+    """
+    assert "TRACED-BRANCH" in active_rules(lint_src(tmp_path, code))
+
+
+# ---------------------------------------------------------------------------
+# suppression grammar
+
+def test_suppression_with_reason(tmp_path):
+    code = """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def step(db, keys, vals):
+            return db.at[keys].set(
+                vals, mode="drop")  # lint: disable=SCATTER-RACE unique keys
+    """
+    findings = lint_src(tmp_path, code)
+    sup = [f for f in findings if f.suppressed]
+    assert active_rules(findings) == []
+    assert len(sup) == 1 and sup[0].rule == "SCATTER-RACE"
+    assert "unique keys" in sup[0].suppress_reason
+
+
+def test_disable_next_form(tmp_path):
+    code = """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def step(db, keys, vals):
+            # lint: disable-next=SCATTER-RACE keys proven unique upstream
+            out = db.at[keys].set(vals, mode="drop")
+            return out
+    """
+    findings = lint_src(tmp_path, code)
+    assert active_rules(findings) == []
+    assert sum(f.suppressed for f in findings) == 1
+
+
+def test_bare_suppression_is_a_finding(tmp_path):
+    code = """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def step(db, keys, vals):
+            # lint: disable-next=SCATTER-RACE
+            return db.at[keys].set(vals, mode="drop")
+    """
+    # the scatter itself is silenced, but the reasonless comment is not
+    assert active_rules(lint_src(tmp_path, code)) == ["SUPPRESS-NO-REASON"]
+
+
+# ---------------------------------------------------------------------------
+# CLI contract
+
+def run_cli(*argv):
+    return subprocess.run(
+        [sys.executable, "-m", "deneva_tpu.lint", *argv],
+        capture_output=True, text=True)
+
+
+def test_cli_exit_nonzero_on_bad_file(tmp_path):
+    p = tmp_path / "bad.py"
+    p.write_text(textwrap.dedent(BAD_SCATTER))
+    r = run_cli(str(p), "--no-jaxpr")
+    assert r.returncode > 0
+    assert "SCATTER-RACE" in r.stdout
+
+
+def test_cli_exit_zero_on_clean_file(tmp_path):
+    p = tmp_path / "good.py"
+    p.write_text(textwrap.dedent(GOOD_SCATTER_ADD))
+    r = run_cli(str(p), "--no-jaxpr")
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_cli_json_format(tmp_path):
+    p = tmp_path / "bad.py"
+    p.write_text(textwrap.dedent(BAD_DTYPE))
+    r = run_cli(str(p), "--no-jaxpr", "--format", "json")
+    doc = json.loads(r.stdout)
+    assert doc["unsuppressed"] == r.returncode > 0
+    assert any(f["rule"] == "IMPLICIT-DTYPE" for f in doc["findings"])
+
+
+# ---------------------------------------------------------------------------
+# jaxpr plugin verifier
+
+def test_verifier_accepts_all_shipped_plugins():
+    from deneva_tpu.cc import REGISTRY
+    from deneva_tpu.lint.jaxpr_engine import verify_all
+    assert len(REGISTRY) >= 7
+    assert verify_all() == []
+
+
+def test_verifier_rejects_contract_violations():
+    from deneva_tpu.cc import REGISTRY, register
+    from deneva_tpu.cc.base import AccessDecision, CCPlugin
+    from deneva_tpu.lint.jaxpr_engine import verify_plugin
+
+    class Broken(CCPlugin):
+        name = "LINT_TEST_BROKEN"
+        txn_db_fields = ()
+
+        def init_db(self, cfg, n_rows, B, R):
+            return {"x": jnp.zeros(n_rows, jnp.int32)}
+
+        def on_start(self, cfg, db, txn, mask_b):
+            # contract violation: output pytree structure changed
+            return {"x": db["x"], "extra": jnp.zeros(3, jnp.float32)}
+
+        def access(self, cfg, db, txn, mask_b):
+            import jax
+            jax.debug.print("boo")  # contract violation: callback prim
+            B, R = txn.keys.shape
+            z = jnp.zeros((B, R), bool)
+            return AccessDecision(grant=z, wait=z, abort=z), db
+
+    register(Broken())
+    try:
+        rules = {f.rule for f in verify_plugin("LINT_TEST_BROKEN")}
+        assert "CONTRACT-STRUCT" in rules
+        assert "CONTRACT-CALLBACK" in rules
+    finally:
+        del REGISTRY["LINT_TEST_BROKEN"]
+
+
+# ---------------------------------------------------------------------------
+# self-lint: the shipped tree stays clean (modulo recorded suppressions)
+
+def test_self_lint_tree_is_clean():
+    import os
+
+    import deneva_tpu
+    pkg = os.path.dirname(deneva_tpu.__file__)
+    findings = [f for f in run_lint([pkg], jaxpr=False) if not f.suppressed]
+    assert findings == [], "\n".join(
+        f"{f.location()}: {f.rule}: {f.message}" for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# scatter-race regression: twopl's held-scratch identity-restore
+
+def test_duplicate_index_set_is_order_dependent():
+    # two S-lock holders of one row -> duplicate row ids in the scatter.
+    # With per-lane payloads, a .set applies in unspecified order: the
+    # lane permutation changes the result, so the schedule would depend
+    # on XLA's scatter ordering.  The commutative .max does not.
+    row = jnp.array([3, 3, 5], jnp.int32)
+    val = jnp.array([10, 20, 30], jnp.int32)
+    base = jnp.zeros(8, jnp.int32)
+    fwd = base.at[row].set(val)
+    rev = base.at[row[::-1]].set(val[::-1])  # same (row, val) pairs
+    assert int(fwd[3]) != int(rev[3])  # order leaks into the result
+    m_fwd = base.at[row].max(val)
+    m_rev = base.at[row[::-1]].max(val[::-1])
+    assert (m_fwd == m_rev).all()
+
+
+def test_twopl_identity_restore_with_duplicate_holders():
+    # arbitrate_window must hand back an identity-valued scratch even when
+    # several read holders share a row (duplicate indices in the restore
+    # scatter); the .max(BIG_TS) restore saturates every touched row back
+    # to the identity regardless of scatter order
+    from deneva_tpu.cc.twopl import BIG_TS, arbitrate_window, init_lock_tmp
+    from deneva_tpu.engine.state import TxnState
+
+    B, R = 4, 2
+    txn = TxnState.empty(B, R)
+    # three txns hold a read lock on row 7 (cursor past the access)
+    txn = txn._replace(
+        keys=txn.keys.at[0:3, 0].set(7),
+        is_write=txn.is_write.at[:, :].set(False),
+        cursor=txn.cursor.at[0:3].set(1),
+        n_req=txn.n_req.at[0:3].set(2),
+        ts=jnp.arange(1, B + 1, dtype=jnp.int32))
+    active = jnp.array([True, True, True, False])
+    tmp = init_lock_tmp(16)
+    *_, tmp2 = arbitrate_window(txn, active, "NO_WAIT", tmp, window=1)
+    assert (tmp2["lk_held"] == BIG_TS).all()

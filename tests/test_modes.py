@@ -122,6 +122,7 @@ def test_invariant_kernel_detects_corruption():
     assert int(dbg.count_violations(cfg, plugin, bad3)) > 0
 
 
+@pytest.mark.slow  # unlocked by the shard_map compat fix; over the tier-1 time budget
 def test_invariant_kernel_clean_sharded():
     from deneva_tpu.parallel.sharded import ShardedEngine
     cfg = Config(cc_alg="NO_WAIT", node_cnt=2, part_cnt=2, batch_size=64,
@@ -134,6 +135,9 @@ def test_invariant_kernel_clean_sharded():
     assert s["txn_cnt"] > 0
 
 
+# Unlocked by the shard_map compat fix (failed at the seed); exceeds
+# the tier-1 time budget -- run with `-m slow`.
+@pytest.mark.slow
 def test_mode_ladder_sharded():
     """The NOCC/QRY_ONLY/SIMPLE ladder now runs through the sharded
     engine (per-node bottleneck isolation, the round-3 gap): each

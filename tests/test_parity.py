@@ -205,6 +205,9 @@ SHARDED_THRESH = {
 }
 
 
+# Unlocked by the shard_map compat fix (failed at the seed); the
+# alg x nodes sweep runs ~80 s and exceeds the tier-1 budget -- `-m slow`.
+@pytest.mark.slow
 @pytest.mark.parametrize("alg", list(SHARDED_THRESH))
 @pytest.mark.parametrize("nodes", [2, 8])
 def test_multi_shard_abort_rate_parity(alg, nodes):

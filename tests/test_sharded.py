@@ -9,7 +9,14 @@ from deneva_tpu.config import Config
 from deneva_tpu.parallel.sharded import ShardedEngine
 from deneva_tpu.engine.scheduler import Engine
 
-ALGS = ["NO_WAIT", "WAIT_DIE", "TIMESTAMP", "MVCC", "OCC", "MAAT"]
+# These were collection errors at the seed (pre shard_map compat fix);
+# the slower four exceed the tier-1 time budget -- run with `-m slow`.
+ALGS = ["NO_WAIT",
+        pytest.param("WAIT_DIE", marks=pytest.mark.slow),
+        pytest.param("TIMESTAMP", marks=pytest.mark.slow),
+        pytest.param("MVCC", marks=pytest.mark.slow),
+        pytest.param("OCC", marks=pytest.mark.slow),
+        "MAAT"]
 
 
 def shard_cfg(n, **kw):
@@ -39,6 +46,7 @@ def test_all_algorithms_four_nodes(alg):
     assert eng.global_data_sum(st) == s["write_cnt"], s
 
 
+@pytest.mark.slow  # unlocked by the shard_map compat fix; over the tier-1 time budget
 def test_read_only_multipartition_never_aborts():
     eng = ShardedEngine(shard_cfg(4, txn_read_perc=1.0, zipf_theta=0.9))
     st = eng.run(30)
@@ -66,6 +74,7 @@ def test_capacity_overflow_aborts_not_corrupts():
     assert eng.global_data_sum(st) == s["write_cnt"]   # still exactly-once
 
 
+@pytest.mark.slow  # unlocked by the shard_map compat fix; over the tier-1 time budget
 def test_single_node_sharded_close_to_single_shard():
     cfg = shard_cfg(1, part_per_txn=1, mpr=0.0, batch_size=64,
                     query_pool_size=1 << 10)
@@ -80,6 +89,7 @@ def test_single_node_sharded_close_to_single_shard():
     assert s_sh["txn_cnt"] > 0.5 * s_si["txn_cnt"]
 
 
+@pytest.mark.slow  # unlocked by the shard_map compat fix; over the tier-1 time budget
 def test_greedy_window_sharded():
     eng = ShardedEngine(shard_cfg(4, acquire_window=4, zipf_theta=0.0,
                                   synth_table_size=1 << 14))

@@ -7,6 +7,7 @@ line), scripts/parse_results.py:19-37 (the consumer this must round-trip
 through), stats_array.cpp (percentile arrays).
 """
 
+import pytest
 import numpy as np
 
 from deneva_tpu import stats as stats_mod
@@ -73,6 +74,7 @@ def test_vabort_and_parts_touched_keys():
     assert s["parts_touched"] == s["txn_cnt"]   # single partition
 
 
+@pytest.mark.slow  # unlocked by the shard_map compat fix; over the tier-1 time budget
 def test_sharded_summary_line():
     from deneva_tpu.parallel.sharded import ShardedEngine
     cfg = Config(cc_alg="NO_WAIT", node_cnt=4, part_cnt=4, batch_size=32,
@@ -135,6 +137,7 @@ def test_cc_case_counter_families():
         == s["vabort_cnt"]
 
 
+@pytest.mark.slow  # unlocked by the shard_map compat fix; over the tier-1 time budget
 def test_cc_counters_sharded_sum_across_nodes():
     from deneva_tpu.parallel.sharded import ShardedEngine
     kw = dict(node_cnt=4, part_cnt=4, batch_size=32,

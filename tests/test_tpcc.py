@@ -224,6 +224,9 @@ class TestSingleShard:
 # sharded end-to-end (8 virtual CPU devices, conftest.py)
 # ---------------------------------------------------------------------------
 
+# Unlocked by the shard_map compat fix (collection error at the seed);
+# ~100 s of 8-node TPC-C exceeds the tier-1 time budget -- `-m slow`.
+@pytest.mark.slow
 class TestSharded:
     @pytest.mark.parametrize("alg", ["NO_WAIT", "WAIT_DIE", "TIMESTAMP",
                                      "MVCC", "OCC", "MAAT", "CALVIN"])

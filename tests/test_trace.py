@@ -2,6 +2,7 @@
 must integrate to the run's totals, and lifetimes in the ring must match
 the latency stats."""
 
+import pytest
 import numpy as np
 
 from deneva_tpu.config import Config
@@ -78,6 +79,7 @@ def test_render_timeline(tmp_path):
     assert os.path.getsize(out) > 10_000
 
 
+@pytest.mark.slow  # unlocked by the shard_map compat fix; over the tier-1 time budget
 def test_sharded_trace():
     from deneva_tpu.parallel.sharded import ShardedEngine
     cfg = Config(cc_alg="WAIT_DIE", node_cnt=4, part_cnt=4, batch_size=32,
