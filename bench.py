@@ -151,6 +151,22 @@ def run_obs(args) -> int:
     return code
 
 
+def run_single_alg(alg: str):
+    """--alg: the headline YCSB cell (faithful, acquire_window=1) under one
+    CC plugin, same one-line JSON shape as the full sweep."""
+    per_chip_star = NORTH_STAR_CLUSTER / NORTH_STAR_CHIPS
+    tput, cpt = run_cell(Config(cc_alg=alg, acquire_window=1, **YCSB_KW))
+    print(json.dumps({
+        "metric": f"ycsb_{alg.lower()}_zipf0.6_tput_faithful",
+        "value": round(float(tput), 1),
+        "unit": "committed_txns_per_sec",
+        "vs_baseline": round(float(tput) / per_chip_star, 4),
+        "commits_per_tick": round(float(cpt), 1),
+        "note": "single-algorithm headline cell (--alg); acquire_window 1; "
+                "vs_baseline = value / (1M-cluster north star / 8 chips)",
+    }))
+
+
 def main():
     per_chip_star = NORTH_STAR_CLUSTER / NORTH_STAR_CHIPS
     faithful, _ = run_cell(Config(cc_alg="NO_WAIT", acquire_window=1,
@@ -200,6 +216,12 @@ def _cli():
                    help="ticks for the observed run (default 200)")
     p.add_argument("--cc-alg", default="NO_WAIT",
                    help="CC algorithm for the observed run")
+    p.add_argument("--alg", default=None,
+                   choices=("NO_WAIT", "WAIT_DIE", "TIMESTAMP", "MVCC",
+                            "OCC", "MAAT", "CALVIN"),
+                   help="run ONLY this algorithm's headline YCSB cell "
+                        "(faithful, acquire_window=1) and print the same "
+                        "one-line JSON")
     p.add_argument("--out-dir", default="results",
                    help="directory for trace JSON + run record")
     return p.parse_args()
@@ -209,4 +231,7 @@ if __name__ == "__main__":
     _args = _cli()
     if _args.trace or _args.profile or _args.prog_interval:
         raise SystemExit(run_obs(_args))
-    main()
+    if _args.alg:
+        run_single_alg(_args.alg)
+    else:
+        main()

@@ -54,6 +54,10 @@ class HookSpec(NamedTuple):
 #: The plugin-boundary contract: every registered plugin's hooks must
 #: abstract-eval under these signatures with a structure-stable db.
 #: Enforced by `python -m deneva_tpu.lint` (engine 2) and scripts/check.sh.
+#: Entry-lane arguments (keys_e/ts_e/mask_e) are width-polymorphic: the
+#: verifier traces them at the COMPACTED width Config.compact_width(B*R)
+#: — callers may hand these hooks a live-prefix view (ops/segment.py) or
+#: the padded B*R lanes, so a hook must never assume the padded geometry.
 KERNEL_CONTRACT: dict = {
     "on_start": HookSpec(args=("txn", "mask_b"), returns=("db",)),
     "access": HookSpec(args=("txn", "mask_b"), returns=("decision", "db")),
@@ -68,6 +72,37 @@ KERNEL_CONTRACT: dict = {
                                           "tick"), returns=("db",)),
     "on_ts_rebase": HookSpec(args=("tick",), returns=("db",)),
 }
+
+
+def compaction_counters(cfg) -> dict:
+    """The two db scalars a plugin carries when the config opts into a
+    live-prefix compaction bucket (ops/segment.py): ``live_entry_cnt``
+    accumulates the live entries offered to compacted kernels per tick
+    (float32 — int32 would wrap within minutes at headline widths) and
+    ``compact_overflow_cnt`` the live entries that ranked beyond the
+    static bucket K and were forced to retry.  Both auto-surface in
+    ``[summary]`` via the db ``_cnt`` convention.  Without the opt-in
+    (``compact_lanes`` / ``compact_auto``) the view is the identity
+    everywhere and the keys are ABSENT — summaries stay comparable with
+    engines that never build an entry view at all (dense lock state),
+    and the db structure is still stable for any given config."""
+    if (not cfg.entry_compaction
+            or (cfg.compact_lanes is None and not cfg.compact_auto)):
+        return {}
+    return {"live_entry_cnt": jnp.zeros((), jnp.float32),
+            "compact_overflow_cnt": jnp.zeros((), jnp.int32)}
+
+
+def note_compaction(db: dict, view) -> dict:
+    """Fold one compact_entries view into the occupancy counters (no-op
+    when the config never opted into a bucket — see above)."""
+    if "live_entry_cnt" not in db:
+        return db
+    return {**db,
+            "live_entry_cnt": db["live_entry_cnt"]
+            + view.n_live.astype(jnp.float32),
+            "compact_overflow_cnt": db["compact_overflow_cnt"]
+            + view.overflow}
 
 
 class AccessDecision(NamedTuple):
@@ -149,7 +184,7 @@ class CCPlugin:
         return commit_try
 
     def init_db(self, cfg: Config, n_rows: int, B: int, R: int) -> dict:
-        return {}
+        return compaction_counters(cfg)
 
     def on_start(self, cfg: Config, db: dict, txn: TxnState,
                  started: jnp.ndarray) -> dict:

@@ -41,6 +41,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from deneva_tpu.cc.base import AccessDecision, CCPlugin
+from deneva_tpu.cc import compact as ccompact
 from deneva_tpu.cc import twopl
 from deneva_tpu.config import Config
 from deneva_tpu.engine.state import TxnState, make_entries
@@ -58,7 +59,14 @@ class Calvin(CCPlugin):
         # from grant to wrapup regardless (system/txn.cpp:778-788).
         # request_all makes every access a request, so the sorted-segment
         # join (not the cursor-window fast path) is the natural kernel.
+        # request_all also means the auto compaction bucket never applies
+        # (every active lane is live); an explicit compact_lanes still
+        # compacts, with spilled txns WAITING out the tick (never_aborts).
         ent = make_entries(txn, active, read_locks_held=True, window=R)
-        g, w, a = twopl.arbitrate(ent, "CALVIN")
+        db, ac = ccompact.compact_access(cfg, db, ent, B, R,
+                                         request_all=True)
+        g, w, a = twopl.arbitrate(ac.ent, "CALVIN")
+        g, w, a = ccompact.finish_access(ac, ent.req, g, w, a,
+                                         never_aborts=True)
         return AccessDecision(grant=g.reshape(B, R), wait=w.reshape(B, R),
                               abort=a.reshape(B, R)), db

@@ -1,0 +1,154 @@
+"""Access-path live-prefix compaction glue shared by the CC plugins.
+
+``ops/segment.py`` provides the width mechanics (compact_entries /
+expand_entries); this module adds the CC-specific safety discipline for
+the ACCESS kernels, where the entry view mixes lanes with very different
+failure semantics:
+
+- a REQUEST lane's owner can always be told to retry (abort, or wait for
+  a never-aborting plugin), so request lanes may spill past the bucket;
+- a HELD lane of a txn that still has requests this tick may also spill:
+  forcing that txn to retry releases its locks, which makes their
+  invisibility to this tick's arbitration consistent with the retry;
+- a HELD lane of a txn with NO requests this tick (a finishing txn,
+  holding its locks to commit) must NEVER be invisible — nothing can
+  force that txn to retry, so a conflicting grant against its unseen
+  lock would break mutual exclusion.
+
+Compaction therefore ranks lanes (non-retryable held, retryable held,
+requests), each class keeping its original relative order.  The
+non-retryable class fits the bucket on every sane tick; if it ever does
+not (``unsafe``), the whole tick's arbitration degrades to all-WAIT — a
+one-tick stall is always conservative, the finishing txns commit and
+release on the next commit phase, and the spill is counted in
+``compact_overflow_cnt``, never silent.
+
+The class reordering cannot perturb decisions relative to the padded
+path: every downstream sort keys on (row, ts, ...) at minimum, per-txn
+timestamps are unique among live txns, and workloads de-duplicate keys
+within a txn — so no two lanes from different classes can tie, and
+stable tie-breaking only ever compares lanes whose relative order
+compaction preserved.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+from jax import lax
+
+from deneva_tpu.cc import base as cc_base
+from deneva_tpu.config import Config
+from deneva_tpu.engine.state import Entries
+from deneva_tpu.ops import segment as seg
+
+
+class AccessCompaction(NamedTuple):
+    """One access-path compaction: the geometry, the compacted entries the
+    kernel should arbitrate, and the spill bookkeeping ``finish_access``
+    folds back into the expanded decision masks."""
+
+    view: seg.CompactView
+    ent: Entries            # width-K entry view (identity when K == n)
+    unsafe: jnp.ndarray     # () bool: non-retryable lanes spilled -> stall
+    ovf_b: jnp.ndarray      # (B,) txns with retryable spilled lanes
+    extras: tuple = ()      # caller payloads compacted with the same sort
+
+
+def compact_access(cfg: Config, db: dict, ent: Entries, B: int, R: int,
+                   request_all: bool = False, extras: tuple = ()):
+    """Compact an access-phase entry view to K lanes (see module doc).
+
+    Returns ``(db, AccessCompaction)``; db carries the occupancy counter
+    bumps.  ``K >= n`` (compaction off / small geometry) yields an
+    identity view with the original entries.  ``extras`` are additional
+    (n,) per-lane arrays the caller needs at the compacted width (e.g.
+    precomputed abort predicates); they ride the same sort and come back
+    as ``.extras``.
+    """
+    n = ent.key.shape[0]
+    K = cfg.compact_width(n, B, request_all=request_all)
+    live = ent.held | ent.req
+    if K >= n:
+        view, _ = seg.compact_entries(live, n)
+        db = cc_base.note_compaction(db, view)
+        return db, AccessCompaction(
+            view=view, ent=ent,
+            unsafe=jnp.zeros((), dtype=bool),
+            ovf_b=jnp.zeros(B, dtype=bool),
+            extras=tuple(extras))
+
+    # lane classes: held lanes of txns with no request this tick cannot be
+    # forced to retry and must rank first (see module doc)
+    has_req_b = jnp.any(ent.req.reshape(B, R), axis=1)
+    has_req_e = jnp.broadcast_to(has_req_b[:, None], (B, R)).reshape(-1)
+    c1 = ent.held & ~has_req_e
+    c2 = ent.held & has_req_e
+    idx = jnp.arange(n, dtype=jnp.int32)
+    keyrank = jnp.where(c1, idx,
+                        jnp.where(c2, n + idx,
+                                  jnp.where(ent.req, 2 * n + idx,
+                                            3 * n + idx)))
+    i32 = jnp.int32
+    conv = tuple(x.astype(i32) if x.dtype == bool else x for x in extras)
+    # lint: disable-next=PAD-WIDTH-SORT this IS the compaction-building sort: it must see all n lanes to rank live ones into the prefix
+    sorted_ = lax.sort(
+        (keyrank, ent.key, ent.txn, ent.ridx, ent.ts,
+         ent.is_write.astype(i32), ent.held.astype(i32),
+         ent.req.astype(i32)) + conv,
+        num_keys=1, is_stable=False)
+    skey = sorted_[0]
+    cent = Entries(
+        key=sorted_[1][:K], txn=sorted_[2][:K], ridx=sorted_[3][:K],
+        ts=sorted_[4][:K], is_write=sorted_[5][:K] == 1,
+        held=sorted_[6][:K] == 1, req=sorted_[7][:K] == 1)
+    cex = tuple(s[:K] == 1 if x.dtype == bool else s[:K]
+                for x, s in zip(extras, sorted_[8:]))
+
+    n_live = jnp.sum(live.astype(i32))
+    n_c1 = jnp.sum(c1.astype(i32))
+    view = seg.CompactView(
+        width=K, n=n, orig_sorted=skey % n, live=skey[:K] < 3 * n,
+        n_live=n_live,
+        overflow=jnp.maximum(n_live - K, jnp.zeros((), i32)))
+    db = cc_base.note_compaction(db, view)
+
+    # spilled lanes: live entries whose class-ordered rank is >= K
+    excl = lambda m: jnp.cumsum(m.astype(i32)) - m.astype(i32)
+    n_c2 = jnp.sum(c2.astype(i32))
+    rank = jnp.where(c1, excl(c1),
+                     jnp.where(c2, n_c1 + excl(c2),
+                               n_c1 + n_c2 + excl(ent.req)))
+    ovf_e = live & (rank >= K)
+    return db, AccessCompaction(
+        view=view, ent=cent,
+        unsafe=n_c1 > K,
+        ovf_b=jnp.any((ovf_e & (c2 | ent.req)).reshape(B, R), axis=1),
+        extras=cex)
+
+
+def finish_access(ac: AccessCompaction, req_e: jnp.ndarray,
+                  grant: jnp.ndarray, wait: jnp.ndarray,
+                  abort: jnp.ndarray, never_aborts: bool = False):
+    """Expand width-K decision masks to full width and fold in the spill
+    semantics: txns with retryable spilled lanes are forced to retry
+    (wait when the plugin never aborts), and an ``unsafe`` tick degrades
+    to all-WAIT.  Returns full-width (grant, wait, abort)."""
+    n = req_e.shape[0]
+    B = ac.ovf_b.shape[0]
+    grant, wait, abort = seg.expand_entries(ac.view, grant, wait, abort)
+    ovf_e = jnp.broadcast_to(ac.ovf_b[:, None], (B, n // B)).reshape(-1)
+    retry = req_e & ovf_e
+    grant = grant & ~ovf_e
+    if never_aborts:
+        wait = (wait & ~ovf_e) | retry
+        abort = abort & ~ovf_e
+    else:
+        wait = wait & ~ovf_e
+        abort = (abort & ~ovf_e) | retry
+    # pathological spill of non-retryable held lanes: stall the tick
+    grant = grant & ~ac.unsafe
+    wait = jnp.where(ac.unsafe, req_e, wait)
+    abort = abort & ~ac.unsafe
+    return grant, wait, abort

@@ -77,6 +77,7 @@ import jax
 import jax.numpy as jnp
 
 from deneva_tpu.cc.base import AccessDecision, CCPlugin
+from deneva_tpu.cc import base as cc_base
 from deneva_tpu.config import Config
 from deneva_tpu.engine.state import (BIG_TS, NULL_KEY, STATUS_RUNNING,
                                      STATUS_WAITING, TxnState, make_entries,
@@ -100,6 +101,7 @@ class Maat(CCPlugin):
 
     def init_db(self, cfg: Config, n_rows: int, B: int, R: int) -> dict:
         db = {
+            **super().init_db(cfg, n_rows, B, R),
             "maat_lr": jnp.zeros(n_rows, jnp.int32),
             "maat_lw": jnp.zeros(n_rows, jnp.int32),
             "maat_lower": jnp.zeros(B, jnp.int32),
@@ -197,18 +199,61 @@ class Maat(CCPlugin):
         # (the sequential access phase runs in ts order)
         atick = (jnp.broadcast_to(txn.start_tick[:, None], (B, R))
                  + ridx // max(cfg.acquire_window, 1)).reshape(-1)
-        orig = jnp.arange(n, dtype=jnp.int32)
+        tx = jnp.broadcast_to(
+            jnp.arange(B, dtype=jnp.int32)[:, None], (B, R)).reshape(-1)
+        if prepared is not None:
+            prep_e = prepared[:, None] if prepared.ndim == 1 else prepared
+            prep_full = (jnp.broadcast_to(prep_e, (B, R))
+                         & granted & live_txn[:, None]).reshape(-1)
+        else:
+            prep_full = jnp.zeros(n, dtype=bool)
+
+        # ---- live-prefix compaction: every sort below runs at the static
+        # bucket K instead of the padded B*R (ops/segment.py).  The
+        # order-preserving single-class compaction keeps `tx` monotone
+        # non-decreasing over the live prefix, so per-txn (B,) -> lane
+        # broadcasts stay cheap monotone gathers.  Spill handling:
+        #   - a FINISHING txn with a spilled lane votes no (forced retry —
+        #     a no-voter neither pushes nor needs pushes);
+        #   - a spilled RUNNING lane stalls every vote this tick: any
+        #     committer might owe that invisible runner a squeeze push,
+        #     and a missed push breaks the range invariant.  No-voting
+        #     validators push nothing, so nothing is missed.
+        # Both spills land in compact_overflow_cnt, never silent.
+        Kc = cfg.compact_width(n, B)
+        view, (key, ts, iw, atick, fin_e, tx, prep_flag) = \
+            seg.compact_entries(ent_live, Kc, key, ts, iw, atick, fin_e,
+                                tx, prep_full)
+        db = cc_base.note_compaction(db, view)
+        ok_allowed = finishing
+        if not view.identity:
+            ovf_e = seg.overflow_mask(ent_live, Kc)
+            fin_full = (finishing[:, None] & granted).reshape(-1)
+            ovf_fin = jnp.any((ovf_e & fin_full).reshape(B, R), axis=1)
+            stall = jnp.any(ovf_e & ~fin_full)
+            ok_allowed = finishing & ~ovf_fin & ~stall
+        nK = key.shape[0]
+        txc = jnp.clip(tx, 0, B - 1)
+        # per-txn value -> compacted lanes (monotone gather: cheap)
+        lane_of = lambda v: v[txc]
 
         # saturating +-1 (the reference pins at 0 / UINT64_MAX,
         # maat.cpp:57-62,81-86; int32 wraparound would erase the push)
         up1 = lambda v: jnp.minimum(v, BIG_TS - 1) + 1
         dn1 = lambda v: jnp.maximum(v, 1) - 1
 
-        def txn_reduce(perm, sorted_val, op):
-            """Per-txn reduction over sorted entries: un-permute to entry
-            order, reduce over the R lanes."""
-            v = seg.unpermute(perm, sorted_val).reshape(B, R)
-            return v.min(axis=1) if op == "min" else v.max(axis=1)
+        def txn_min(tx_s, val_s, base):
+            """min-combine sorted-order lane values into (B,) — a
+            commutative scatter, race-free under duplicate txn lanes;
+            dead lanes carry the neutral BIG_TS."""
+            acc = jnp.full(B, BIG_TS, jnp.int32).at[
+                jnp.clip(tx_s, 0, B - 1)].min(val_s)
+            return jnp.minimum(base, acc)
+
+        def txn_max(tx_s, val_s, base):
+            acc = jnp.zeros(B, jnp.int32).at[
+                jnp.clip(tx_s, 0, B - 1)].max(val_s)
+            return jnp.maximum(base, acc)
 
         # cases 1/3: lower above the greatest committed write/read ts seen
         # at access time (snapshots).  Independent of same-tick neighbors.
@@ -231,16 +276,11 @@ class Maat(CCPlugin):
             #   prepared member of a row I write -> lower >= its upper+1
             # Static per-entry prefix scans in access order; results fold
             # into the chain's base bounds.
-            prep_e = prepared[:, None] if prepared.ndim == 1 else prepared
-            prep_e = (jnp.broadcast_to(prep_e, (B, R))
-                      & granted & live_txn[:, None]).reshape(-1)
-            lo_b = jnp.broadcast_to(db["maat_lower"][:, None],
-                                    (B, R)).reshape(-1)
-            up_b = jnp.broadcast_to(db["maat_upper"][:, None],
-                                    (B, R)).reshape(-1)
-            (k5, a5, t5), (w5, p5, lo5, up5, f5, orig5) = seg.sort_by(
+            lo_b = lane_of(db["maat_lower"])
+            up_b = lane_of(db["maat_upper"])
+            (k5, a5, t5), (w5, p5, lo5, up5, f5, tx5) = seg.sort_by(
                 (key, atick, ts),
-                (iw, prep_e, lo_b, up_b, fin_e, orig))
+                (iw, prep_flag, lo_b, up_b, fin_e, tx))
             st5 = seg.segment_starts(k5)
             pre_pw = seg.seg_prefix_min(
                 jnp.where(p5 & w5, dn1(lo5), BIG_TS), st5, BIG_TS)
@@ -248,13 +288,8 @@ class Maat(CCPlugin):
                 jnp.where(p5, up1(up5), 0), st5, 0)
             cap5 = jnp.where(f5 & ~w5, pre_pw, BIG_TS)
             push5 = jnp.where(f5 & w5, pre_pa, 0)
-            cap_p, push_p = seg.unpermute_many(orig5, cap5, push5)
-            upper0 = jnp.minimum(upper0,
-                                 cap_p.reshape(B, R).min(axis=1))
-            lower = jnp.maximum(lower, push_p.reshape(B, R).max(axis=1))
-            prep_flag = prep_e
-        else:
-            prep_flag = jnp.zeros(n, dtype=bool)
+            upper0 = txn_min(tx5, cap5, upper0)
+            lower = txn_max(tx5, push5, lower)
         static_lower = lower
 
         # ---- same-tick commit chain, access-order aware ----
@@ -271,20 +306,20 @@ class Maat(CCPlugin):
         # Sort: finishing entries first within each row, in validation
         # (ts) order; runner entries follow and never pollute the prefix.
         nf = jnp.where(fin_e, 0, 1).astype(jnp.int32)
-        (k3, nf3, t3), (iw3i, at3, orig3) = seg.sort_by(
-            (key, nf, ts), (iw.astype(jnp.int32), atick, orig))
+        (k3, nf3, t3), (iw3i, at3, tx3) = seg.sort_by(
+            (key, nf, ts), (iw.astype(jnp.int32), atick, tx))
         iw3 = iw3i == 1
         st3 = seg.segment_starts(k3)
         fin3 = (nf3 == 0) & (k3 != NULL_KEY)
         # my (key, txn)-run start: same txn's entries on one key share ts
         run_start3 = st3 | (t3 != jnp.roll(t3, 1))
         M = max(int(cfg.maat_chain_window), 1)
-        # jnp.roll wraps: lane i < d would pair with lane n-d+i (the
+        # jnp.roll wraps: lane i < d would pair with lane nK-d+i (the
         # ARRAY's tail, not a chain predecessor) whenever one key's run
         # spans the whole array — degenerate single-key workloads hit
         # this.  The key-equality guard normally breaks cross-key wraps
         # but not same-key ones; mask the wrapped lanes explicitly.
-        lane = jnp.arange(n, dtype=jnp.int32)
+        lane = jnp.arange(nK, dtype=jnp.int32)
 
         # The pair window's STATIC classification is bit-packed — 2 bits
         # per distance d — into one int32 lane array: 0 = no pair,
@@ -294,7 +329,7 @@ class Maat(CCPlugin):
         # fixed-point while carry (a scoped-memory copy storm measured at
         # several ms/tick on TPU); the packed word keeps the carry small
         # and the per-step unpack is a free elementwise shift.
-        wcode = jnp.zeros(n, jnp.int32)
+        wcode = jnp.zeros(nK, jnp.int32)
         for d in range(1, min(M, 16)):
             pair_s = (fin3 & iw3 & jnp.roll(fin3, d) & (lane >= d)
                       & (jnp.roll(k3, d) == k3)
@@ -321,19 +356,18 @@ class Maat(CCPlugin):
                 .astype(jnp.int8))
 
         def to_chain(*vals_B):
-            """Broadcast per-txn (B,) values to entries and permute into
-            the chain sort's order by re-sorting on the same fixed keys —
-            on TPU one extra sort is ~4x cheaper than the per-lane
-            valid[s_tx]-style gathers it replaces (PROFILE.md).
+            """Broadcast per-txn (B,) values to the compacted lanes (a
+            monotone gather) and permute into the chain sort's order by
+            re-sorting on the same fixed keys — on TPU one extra sort is
+            ~4x cheaper than the per-lane valid[s_tx]-style gathers it
+            replaces (PROFILE.md).
 
             PRECONDITION: (key, nf, ts) ties are intra-txn only — nf is
             per-txn-constant and timestamps are unique per live txn — so
             this is_stable=False re-sort can only permute lanes WITHIN one
             txn's run, and only per-txn-constant payloads may ship
             through it."""
-            pay = tuple(jnp.broadcast_to(v[:, None].astype(jnp.int32),
-                                         (B, R)).reshape(-1)
-                        for v in vals_B)
+            pay = tuple(lane_of(v).astype(jnp.int32) for v in vals_B)
             out = jax.lax.sort((key, nf, ts) + pay, num_keys=3,
                                is_stable=False)
             return out[3:]
@@ -393,12 +427,10 @@ class Maat(CCPlugin):
                         cls == 2, up1(p_lo),
                         jnp.where(cls == 3, up1(p_up_eff), 0))
                 push_e = jnp.maximum(push_e, push_d)
-            # ONE unpermute sort ships both reductions home
-            up_e, lo_e = seg.unpermute_many(orig3, cap_e, push_e)
-            upper_new = jnp.minimum(upper0,
-                                    up_e.reshape(B, R).min(axis=1))
-            lower_new = jnp.maximum(static_lower,
-                                    lo_e.reshape(B, R).max(axis=1))
+            # per-txn combine straight from chain order (commutative
+            # scatter — replaces the old unpermute sort + (B, R) reshape)
+            upper_new = txn_min(tx3, cap_e, upper0)
+            lower_new = txn_max(tx3, push_e, static_lower)
             if R == 1 and cfg.node_cnt > 1:
                 # sharded virtual-entry context: the reference keeps ONE
                 # TimeTable record per (node, txn) — a push received on
@@ -418,7 +450,7 @@ class Maat(CCPlugin):
         def step(carry):
             okv, lov, upv, _ = carry
             lower_new, upper_new = caps(okv, lov, upv)
-            new_ok = finishing & (lower_new < upper_new)
+            new_ok = ok_allowed & (lower_new < upper_new)
             changed = (jnp.any(new_ok != okv) | jnp.any(lower_new != lov)
                        | jnp.any(upper_new != upv))
             return new_ok, lower_new, upper_new, changed
@@ -429,7 +461,7 @@ class Maat(CCPlugin):
         # runs only for genuinely deeper chains.  `upper` rides the carry,
         # so no extra caps() pass is needed after convergence: the loop
         # exits exactly when a step reproduces its inputs.
-        ok, lower, upper, ch = step((finishing, static_lower, upper0,
+        ok, lower, upper, ch = step((ok_allowed, static_lower, upper0,
                                      jnp.any(finishing) | True))
         ok, lower, upper, ch = step((ok, lower, upper, ch))
 
@@ -459,6 +491,7 @@ class Maat(CCPlugin):
         if R == 1 and cfg.node_cnt > 1:
             gord = jnp.arange(B, dtype=jnp.int32)
             gkey = jnp.where(finishing, txn.ts, NULL_KEY)
+            # lint: disable-next=PAD-WIDTH-SORT (B,)-wide per-txn ts-group sort (sharded R==1 owner view): width is the txn axis, not padded B*R entries
             (g_sorted,), (g_orig,) = seg.sort_by((gkey,), (gord,))
             rep = seg.unpermute(
                 g_orig, seg.segment_starts(g_sorted)) & finishing
@@ -506,12 +539,10 @@ class Maat(CCPlugin):
         # payloads instead of gathered per lane afterwards
         lo_cur = jnp.where(finishing, lower, db["maat_lower"])
         up_cur = jnp.where(finishing, upper, db["maat_upper"])
-        bcast = lambda v: jnp.broadcast_to(
-            v[:, None].astype(jnp.int32), (B, R)).reshape(-1)
-        (k2, a2, t2), (w2, f2, p2, ok2, lo2, up2, orig2) = seg.sort_by(
+        (k2, a2, t2), (w2, f2, p2, ok2, lo2, up2, tx2) = seg.sort_by(
             (key, atick, ts),
-            (iw, fin_e, prep_flag, bcast(ok), bcast(lo_cur), bcast(up_cur),
-             orig))
+            (iw, fin_e, prep_flag, lane_of(ok), lane_of(lo_cur),
+             lane_of(up_cur), tx))
         st2 = seg.segment_starts(k2)
         live2 = k2 != NULL_KEY
         okx = ok2 == 1
@@ -537,15 +568,15 @@ class Maat(CCPlugin):
                              jnp.where(lo2 > 1, lo2 - 1, BIG_TS)),
                          BIG_TS)
         pre_cand = seg.seg_prefix_min(cand, st2, BIG_TS)
-        adj = txn_reduce(orig2, jnp.where(live2 & f2, pre_cand, BIG_TS),
-                 "min")
+        adj = txn_min(tx2, jnp.where(live2 & f2, pre_cand, BIG_TS),
+                      jnp.full(B, BIG_TS, jnp.int32))
         cand_r = jnp.where(run2 & ~w2, up1(up2), 0)
         pre_cand_r = seg.seg_prefix_max(cand_r, st2, 0)
         # the reader-jump is gated per committer: only rows it WROTE (the
         # before set comes from prewrites), and only while it stays below
         # its (pre-duck) upper
-        adj_lo = txn_reduce(orig2, jnp.where(live2 & f2 & w2,
-                                             pre_cand_r, 0), "max")
+        adj_lo = txn_max(tx2, jnp.where(live2 & f2 & w2, pre_cand_r, 0),
+                         jnp.zeros(B, jnp.int32))
         lower_v = jnp.where(ok & (adj_lo > lower) & (adj_lo < upper),
                             adj_lo, lower)
         upper_v = jnp.where(ok, jnp.maximum(jnp.minimum(upper, adj),
@@ -553,7 +584,7 @@ class Maat(CCPlugin):
         # re-sort shipping of BOTH ducked bounds (same precondition as
         # to_chain: ts unique per txn, payload per-txn-constant)
         _, _, _, up2c, lo2c = jax.lax.sort(
-            (key, atick, ts, bcast(upper_v), bcast(lower_v)),
+            (key, atick, ts, lane_of(upper_v), lane_of(lower_v)),
             num_keys=3, is_stable=False)
 
         # committers AFTER me in access order saw my entry (I was in their
@@ -591,11 +622,8 @@ class Maat(CCPlugin):
         new_lo2 = jnp.where(run2 & w2, w_lo, 0)
         new_up2 = jnp.where(run2, jnp.where(w2, w_up, r_up), BIG_TS)
 
-        up_e2, lo_e2 = seg.unpermute_many(orig2, new_up2, new_lo2)
-        upper_arr = jnp.minimum(db["maat_upper"],
-                                up_e2.reshape(B, R).min(axis=1))
-        lower_arr = jnp.maximum(db["maat_lower"],
-                                lo_e2.reshape(B, R).max(axis=1))
+        upper_arr = txn_min(tx2, new_up2, db["maat_upper"])
+        lower_arr = txn_max(tx2, new_lo2, db["maat_lower"])
         # also persist the validators' own tightened bounds (lower_v is
         # the commit_ts find_bound reads)
         upper_arr = jnp.where(finishing, upper_v, upper_arr)

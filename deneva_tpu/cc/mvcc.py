@@ -56,6 +56,7 @@ import jax.numpy as jnp
 
 
 from deneva_tpu.cc.base import AccessDecision, CCPlugin
+from deneva_tpu.cc import compact as ccompact
 from deneva_tpu.config import Config
 from deneva_tpu.engine.state import (BIG_TS, NULL_KEY, TxnState,
                                      contract_window, expand_window,
@@ -75,6 +76,7 @@ class Mvcc(CCPlugin):
         # plain 1-D scatters (PROFILE.md)
         H = cfg.his_recycle_len
         return {
+            **super().init_db(cfg, n_rows, B, R),
             "w_ring": jnp.zeros(n_rows * H, jnp.int32),
             "r_ring": jnp.zeros(n_rows * H, jnp.int32),
             "rts0": jnp.zeros(n_rows, jnp.int32),
@@ -116,7 +118,6 @@ class Mvcc(CCPlugin):
 
     def access(self, cfg: Config, db: dict, txn: TxnState, active):
         ent = make_entries(txn, active, window=cfg.acquire_window)
-        n = ent.key.shape[0]
         B, R = txn.keys.shape
         n_rows = db["rts0"].shape[0]
         H = db["w_ring"].shape[0] // n_rows
@@ -141,11 +142,18 @@ class Mvcc(CCPlugin):
             txn, evicted_w.reshape(B, W)).reshape(-1)
         v_ts = expand_window(txn, v_ts_w.reshape(B, W)).reshape(-1)
 
-        # pending-prewrite prefix per row segment (ts order)
+        # pending-prewrite prefix per row segment (ts order), at the
+        # compacted live width (cc/compact.py: finishing txns' held
+        # prewrites rank first, so they can never become invisible)
+        db, ac = ccompact.compact_access(cfg, db, ent, B, R,
+                                         extras=(w_abort, evicted, v_ts))
+        c = ac.ent
+        w_ab_c, evict_c, v_ts_c = ac.extras
+        nK = c.key.shape[0]
         (skey, sts), (s_iw, s_held, s_req, s_wab, s_orig) = seg.sort_by(
-            (ent.key, ent.ts),
-            (ent.is_write, ent.held, ent.req, w_abort,
-             jnp.arange(n, dtype=jnp.int32)),
+            (c.key, c.ts),
+            (c.is_write, c.held, c.req, w_ab_c,
+             jnp.arange(nK, dtype=jnp.int32)),
         )
         starts = seg.segment_starts(skey)
         live = skey != NULL_KEY
@@ -154,13 +162,15 @@ class Mvcc(CCPlugin):
         pref = seg.seg_prefix_max(jnp.where(pending_w, sts, 0), starts)
         pts = seg.unpermute(s_orig, pref)
 
-        r_wait = (pts > v_ts) & (pts > 0)
-        r_abort = evicted
+        r_wait = (pts > v_ts_c) & (pts > 0)
+        r_abort = evict_c
 
-        grant_e = ent.req & jnp.where(ent.is_write, ~w_abort,
-                                      ~r_abort & ~r_wait)
-        wait_e = ent.req & ~ent.is_write & ~r_abort & r_wait
-        abort_e = ent.req & ~grant_e & ~wait_e
+        grant_e = c.req & jnp.where(c.is_write, ~w_ab_c,
+                                    ~r_abort & ~r_wait)
+        wait_e = c.req & ~c.is_write & ~r_abort & r_wait
+        abort_e = c.req & ~grant_e & ~wait_e
+        grant_e, wait_e, abort_e = ccompact.finish_access(
+            ac, ent.req, grant_e, wait_e, abort_e)
 
         # granted reads record their rts on the version they read;
         # scatter from the request lanes (grant only exists there)

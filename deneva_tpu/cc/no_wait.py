@@ -19,6 +19,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from deneva_tpu.cc.base import AccessDecision, CCPlugin
+from deneva_tpu.cc import compact as ccompact
 from deneva_tpu.cc import twopl
 from deneva_tpu.config import Config, READ_UNCOMMITTED, READ_COMMITTED, NOLOCK
 from deneva_tpu.engine.state import TxnState, make_entries, NULL_KEY
@@ -38,9 +39,10 @@ class TwoPLPlugin(CCPlugin):
                 and cfg.acquire_window <= 8)
 
     def init_db(self, cfg: Config, n_rows: int, B: int, R: int) -> dict:
+        db = super().init_db(cfg, n_rows, B, R)
         if self._window_path(cfg):
-            return twopl.init_lock_tmp(n_rows)
-        return {}
+            db.update(twopl.init_lock_tmp(n_rows))
+        return db
 
     def access(self, cfg: Config, db: dict, txn: TxnState, active):
         B, R = txn.keys.shape
@@ -80,7 +82,12 @@ class TwoPLPlugin(CCPlugin):
             ent = ent._replace(key=jnp.where(drop, NULL_KEY, ent.key),
                                req=ent.req & ~drop)
 
-        g, w, a = twopl.arbitrate(ent, self.policy)
+        # sorted-segment join at the compacted live width (ops/segment.py);
+        # spilled retryable lanes abort-and-retry, counted in
+        # compact_overflow_cnt (cc/compact.py)
+        db, ac = ccompact.compact_access(cfg, db, ent, B, R)
+        g, w, a = twopl.arbitrate(ac.ent, self.policy)
+        g, w, a = ccompact.finish_access(ac, ent.req, g, w, a)
         return AccessDecision(grant=g.reshape(B, R) | bypass,
                               wait=w.reshape(B, R),
                               abort=a.reshape(B, R)), db

@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 
 from deneva_tpu.cc.base import AccessDecision, CCPlugin
+from deneva_tpu.cc import base as cc_base
 from deneva_tpu.config import Config
 from deneva_tpu.engine.state import TxnState, NULL_KEY, make_entries
 from deneva_tpu.ops import segment as seg
@@ -38,7 +39,8 @@ class Occ(CCPlugin):
     release_on_vabort = True   # prepare marks need the RFIN(abort) release
 
     def init_db(self, cfg: Config, n_rows: int, B: int, R: int) -> dict:
-        db = {"occ_wcommit": jnp.full(n_rows, -1, jnp.int32),
+        db = {**super().init_db(cfg, n_rows, B, R),
+              "occ_wcommit": jnp.full(n_rows, -1, jnp.int32),
               # validation outcome counters (the occ_check/abort families
               # of statistics/stats.h): history-check failures vs
               # active-set conflicts; warmup-gated, surfaced in [summary]
@@ -186,8 +188,22 @@ class Occ(CCPlugin):
         tx = jnp.broadcast_to(
             jnp.arange(B, dtype=jnp.int32)[:, None], (B, R)).reshape(-1)
         n = B * R
-        (skey, sts), (s_iw, s_tx, s_orig) = seg.sort_by(
-            (key, ts), (iw, tx, jnp.arange(n, dtype=jnp.int32)))
+
+        # live lanes (finishing, history-passed) compact to the static
+        # bucket K: the whole fixed point then sorts K lanes per
+        # iteration instead of the padded B*R.  All lanes here are
+        # retryable — a spilled lane's txn simply votes no (forced
+        # retry), exactly a failed validator leaving the active set —
+        # so no class ranking is needed (contrast cc/compact.py).
+        Kc = cfg.compact_width(n, B)
+        view, (key, ts, iw, tx) = seg.compact_entries(
+            ent_live, Kc, key, ts, iw, tx)
+        db = cc_base.note_compaction(db, view)
+        if not view.identity:
+            ovf_b = jnp.any(
+                seg.overflow_mask(ent_live, Kc).reshape(B, R), axis=1)
+            pass1 = pass1 & ~ovf_b
+        (skey, sts), (s_iw, s_tx) = seg.sort_by((key, ts), (iw, tx))
         starts = seg.segment_starts(skey)
         live = skey != NULL_KEY
         # a txn never conflicts with itself (test_valid intersects OTHER
@@ -207,11 +223,13 @@ class Occ(CCPlugin):
             # per-(owner, home txn), not per row.
             gord = jnp.arange(B, dtype=jnp.int32)
             gkey = jnp.where(finishing, txn.ts, NULL_KEY)
+            # lint: disable-next=PAD-WIDTH-SORT (B,)-wide per-txn ts-group sort (sharded R==1 owner view): width is the txn axis, not padded B*R entries
             (g_sorted,), (g_orig,) = seg.sort_by((gkey,), (gord,))
             gstarts = seg.segment_starts(g_sorted)
 
             def group_and(ok_e):
                 bad = (finishing & ~ok_e).astype(jnp.int32)
+                # lint: disable-next=PAD-WIDTH-SORT same (B,)-wide per-txn ts-group reduction as above: re-sorts on the fixed group keys
                 _, _, s_bad = jax.lax.sort((gkey, gord, bad), num_keys=2,
                                            is_stable=False)
                 g_bad = seg.seg_reduce(s_bad, gstarts, "max")
@@ -224,8 +242,9 @@ class Occ(CCPlugin):
             # ship per-txn validity into sorted entry order by re-sorting
             # on the SAME fixed keys (a 3-operand sort is ~4x cheaper than
             # the per-lane gathers valid[s_tx] / cnt[run_start_idx] it
-            # replaces, PROFILE.md)
-            valid_e = jnp.broadcast_to(valid[:, None], (B, R)).reshape(-1)
+            # replaces, PROFILE.md); compaction preserves txn-major order
+            # so valid[tx] stays a monotone gather
+            valid_e = valid[jnp.clip(tx, 0, B - 1)]
             _, _, s_valid = jax.lax.sort(
                 (key, ts, valid_e.astype(jnp.int32)), num_keys=2,
                 is_stable=False)
@@ -234,8 +253,13 @@ class Occ(CCPlugin):
                 blocking.astype(jnp.int32), starts)
             at_start = seg.at_run_start(cnt_before, run_start, starts,
                                         -1, "max")
-            conflict = seg.unpermute(s_orig, live & (at_start > 0))
-            new_valid = pass1 & ~conflict.reshape(B, R).any(axis=1)
+            # per-txn ANY via scatter-max straight from sorted order
+            # (commutative, duplicate txn lanes race-free; dead lanes drop
+            # at index B) — replaces the old unpermute + (B, R) reshape
+            conflict_b = jnp.zeros(B, jnp.int32).at[
+                jnp.where(live & (at_start > 0), s_tx, B)].max(
+                1, mode="drop")
+            new_valid = pass1 & (conflict_b == 0)
             if group_and is not None:
                 new_valid = group_and(new_valid)
             return new_valid, jnp.any(new_valid != valid)

@@ -40,6 +40,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from deneva_tpu.cc.base import AccessDecision, CCPlugin
+from deneva_tpu.cc import compact as ccompact
 from deneva_tpu.cc.twopl import ts_groups
 from deneva_tpu.config import Config
 from deneva_tpu.engine.state import (NULL_KEY, TxnState, contract_window,
@@ -76,6 +77,7 @@ class Timestamp(CCPlugin):
 
     def init_db(self, cfg: Config, n_rows: int, B: int, R: int) -> dict:
         return {
+            **super().init_db(cfg, n_rows, B, R),
             "wts": jnp.zeros(n_rows, jnp.int32),
             "rts": jnp.zeros(n_rows, jnp.int32),
         }
@@ -107,9 +109,16 @@ class Timestamp(CCPlugin):
         w_abort = expand_window(txn, w_abort_w).reshape(-1)
         r_abort = expand_window(txn, r_abort_w).reshape(-1)
 
+        # (key, ts) sort chain at the compacted live width; held prewrites
+        # of finishing txns rank first so they can never become invisible
+        # (cc/compact.py class discipline)
+        db, ac = ccompact.compact_access(cfg, db, ent, B, R,
+                                         extras=(w_abort, r_abort))
         grant_e, wait_e, abort_e = _decide(
-            ent.key, ent.ts, ent.is_write, ent.held, ent.req,
-            w_abort, r_abort)
+            ac.ent.key, ac.ent.ts, ac.ent.is_write, ac.ent.held, ac.ent.req,
+            *ac.extras)
+        grant_e, wait_e, abort_e = ccompact.finish_access(
+            ac, ent.req, grant_e, wait_e, abort_e)
 
         # granted reads advance rts immediately (row_ts.cpp:187-189);
         # scatter from the request lanes (grant is only ever set there)

@@ -181,6 +181,30 @@ class Config:
     ts_twr: bool = False              # TS_TWR Thomas write rule (config.h:123)
     his_recycle_len: int = 8          # HIS_RECYCLE_LEN: MVCC version-ring slots
 
+    # --- live-entry compaction (ops/segment.py compact_entries) ---
+    #: run the CC sort chains at a compacted live-entry width instead of
+    #: the padded B*R entry view (PROFILE.md round 5).  Decisions are
+    #: bit-identical to the padded path whenever nothing overflows the
+    #: bucket (compact_overflow_cnt == 0); overflowed txns are forced to
+    #: retry, never silently dropped.
+    entry_compaction: bool = True
+    #: derive a sub-padded bucket automatically from the cursor model:
+    #: ``K = B * (ceil(R/2) + window)`` rounded up to a lane multiple
+    #: (steady-state cursors are ~uniform over [0, R], so live entries
+    #: per txn average R/2 held plus the request window), capped at B*R.
+    #: OPT-IN because any K < B*R can overflow on admission-burst ticks
+    #: — the spill is counted and legal (forced retries), but it makes
+    #: the schedule diverge from the padded one, which would break the
+    #: exact sequential-oracle parity the default config guarantees
+    #: (PARITY.md).  Off, and with no explicit ``compact_lanes``, the
+    #: view is the identity and every kernel runs the padded width
+    #: bit-identically.
+    compact_auto: bool = False
+    #: static compacted lane count K (explicit opt-in, takes precedence
+    #: over ``compact_auto``).  K >= B*R statically disables compaction —
+    #: the kernels run the padded view untouched.
+    compact_lanes: Optional[int] = None
+
     #: MaaT same-tick commit-chain pair window (cc/maat.py): validators
     #: finishing in the same tick on the same row push each other with
     #: formulas that depend on per-row ACCESS order (maat.cpp before/after
@@ -316,6 +340,28 @@ class Config:
     @property
     def epoch_size(self) -> int:
         return self.seq_batch_size if self.seq_batch_size is not None else self.batch_size
+
+    def compact_width(self, n_entries: int, batch: int,
+                      request_all: bool = False) -> int:
+        """Static compacted lane count K for an ``n_entries = B * R`` entry
+        view (ops/segment.py compact_entries).  Returns ``n_entries`` when
+        compaction is off, not opted in (neither ``compact_lanes`` nor
+        ``compact_auto``), explicitly oversized, or useless (request_all
+        plugins keep every lane of every active txn live, so the cursor
+        bucket does not apply — Calvin compacts only under an explicit
+        ``compact_lanes``).
+        """
+        if not self.entry_compaction or n_entries <= 0 or batch <= 0:
+            return n_entries
+        if self.compact_lanes is not None:
+            return min(max(self.compact_lanes, 1), n_entries)
+        if request_all or not self.compact_auto:
+            return n_entries
+        R = n_entries // batch
+        avg_live = -(-R // 2) + min(self.acquire_window, R)  # ceil(R/2) + W
+        K = batch * avg_live
+        K = -(-K // 256) * 256          # round up to a lane multiple
+        return min(K, n_entries)
 
     def replace(self, **kw) -> "Config":
         return dataclasses.replace(self, **kw)

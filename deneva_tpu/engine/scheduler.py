@@ -327,6 +327,10 @@ def make_tick(cfg: Config, plugin, pool_dev: dict, workload=None):
         tables = state.tables
         t = state.tick
         measuring = t >= cfg.warmup_ticks
+        # compaction-counter baseline: the trace row records this tick's
+        # DELTA of the cumulative note_compaction counters (cc/base.py)
+        live_base = db.get("live_entry_cnt")
+        ovf_base = db.get("compact_overflow_cnt")
 
         # ---- 1. backoff expiry: restart aborted txns ----
         expire = (txn.status == STATUS_BACKOFF) & (txn.backoff_until <= t)
@@ -603,6 +607,10 @@ def make_tick(cfg: Config, plugin, pool_dev: dict, workload=None):
         # latency decomposition integrals: txn-ticks per end-of-tick state
         stats = track_state_latencies(stats, txn, measuring)
         if cfg.trace_ticks > 0:
+            live_delta, ovf_delta = 0, 0
+            if "live_entry_cnt" in db:
+                live_delta = db["live_entry_cnt"] - live_base
+                ovf_delta = db["compact_overflow_cnt"] - ovf_base
             stats = obs_trace.record_tick(
                 stats, t, txn.status,
                 admit=n_free,
@@ -610,7 +618,8 @@ def make_tick(cfg: Config, plugin, pool_dev: dict, workload=None):
                 abort=jnp.sum(abort_total.astype(jnp.int32)),
                 vabort=jnp.sum(vabort.astype(jnp.int32)),
                 user_abort=jnp.sum(ua.astype(jnp.int32)),
-                lock_wait=jnp.sum(wait.astype(jnp.int32)))
+                lock_wait=jnp.sum(wait.astype(jnp.int32)),
+                live_entries=live_delta, compact_ovf=ovf_delta)
 
         # ts wraparound guard: only relative order matters, and every live
         # txn's ts lies within [ts_counter - horizon, ts_counter], so rebase

@@ -60,6 +60,13 @@ _DTYPE_CTORS = {"arange": 4, "zeros": 2, "ones": 2, "full": 3, "empty": 2}
 
 _DATA_DEP = frozenset({"nonzero", "flatnonzero", "argwhere", "unique"})
 
+#: calls that produce a live-entry compaction view (ops/segment.py,
+#: cc/compact.py); their presence arms PAD-WIDTH-SORT for the scope
+_COMPACTORS = frozenset({"compact_entries", "compact_access"})
+
+#: sort entry points whose operand width PAD-WIDTH-SORT inspects
+_SORT_CALLS = frozenset({"sort_by", "sort_pack"})
+
 _HOST_ROOTS = ("time.", "numpy.random.", "random.")
 _HOST_NAMES = frozenset({"print", "input", "breakpoint", "open"})
 
@@ -305,10 +312,65 @@ class _Env:
                 self.vals[node.target.id] = node.value
 
 
+def _flat_names(target: ast.AST):
+    """Name targets of an assignment, flattening tuple/list/starred."""
+    if isinstance(target, ast.Name):
+        yield target.id
+    elif isinstance(target, (ast.Tuple, ast.List)):
+        for e in target.elts:
+            yield from _flat_names(e)
+    elif isinstance(target, ast.Starred):
+        yield from _flat_names(target.value)
+
+
+class _CompactScope:
+    """PAD-WIDTH-SORT dataflow: the line a compaction view is first built
+    and the (flow-insensitively grown) set of names derived from it."""
+
+    def __init__(self, scope: ast.AST):
+        self.arm_line = 0           # 0: no compaction view in this scope
+        self.derived: set[str] = set()
+        assigns = []
+        for node in ast.walk(scope):
+            if isinstance(node, ast.Call):
+                fn = node.func
+                bare = fn.attr if isinstance(fn, ast.Attribute) else (
+                    fn.id if isinstance(fn, ast.Name) else None)
+                if bare in _COMPACTORS:
+                    self.arm_line = min(self.arm_line or node.lineno,
+                                        node.lineno)
+            if isinstance(node, ast.Assign):
+                assigns.append((node.lineno, node.targets, node.value))
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                assigns.append((node.lineno, [node.target], node.value))
+        if not self.arm_line:
+            return
+        # two passes: late assignments can feed names used even later,
+        # and the walk above is not guaranteed to be in line order
+        for _ in range(2):
+            for _ln, targets, value in sorted(assigns, key=lambda a: a[0]):
+                if self._derived_expr(value):
+                    for t in targets:
+                        self.derived.update(_flat_names(t))
+
+    def _derived_expr(self, node: ast.AST) -> bool:
+        for c in ast.walk(node):
+            if isinstance(c, ast.Call):
+                fn = c.func
+                bare = fn.attr if isinstance(fn, ast.Attribute) else (
+                    fn.id if isinstance(fn, ast.Name) else None)
+                if bare in _COMPACTORS:
+                    return True
+            elif isinstance(c, ast.Name) and c.id in self.derived:
+                return True
+        return False
+
+
 class KernelChecker(ast.NodeVisitor):
     def __init__(self, fi: FileIndex, scope: ast.AST):
         self.fi = fi
         self.env = _Env(scope)
+        self.compact = _CompactScope(scope)
         self.findings: list[Finding] = []
 
     # -- shared helpers ---------------------------------------------------
@@ -444,7 +506,31 @@ class KernelChecker(ast.NodeVisitor):
                        "per tick")
 
         self._check_scatter(node)
+        self._check_pad_sort(node, fn)
         self.generic_visit(node)
+
+    def _check_pad_sort(self, node: ast.Call, fn: str | None):
+        """PAD-WIDTH-SORT: a sort chain at padded width in a scope that
+        already built a compacted live-entry view."""
+        if not self.compact.arm_line or node.lineno <= self.compact.arm_line:
+            return
+        f = node.func
+        bare = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None)
+        is_sort = fn == "jax.lax.sort" or bare in _SORT_CALLS
+        if not is_sort:
+            return
+        operands = list(node.args) + [k.value for k in node.keywords]
+        for a in operands:
+            for c in ast.walk(a):
+                if isinstance(c, ast.Name) \
+                        and c.id in self.compact.derived:
+                    return
+        self._emit("PAD-WIDTH-SORT", node,
+                   f"{bare or fn}() on arrays not derived from the "
+                   "compaction view built earlier in this scope — the "
+                   "chain runs at the full padded width, not the live "
+                   "bucket K")
 
     def _check_scatter(self, node: ast.Call):
         f = node.func

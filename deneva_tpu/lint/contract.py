@@ -21,8 +21,12 @@ B, R = 8, 4
 def make_cfg(alg: str) -> Config:
     from deneva_tpu.config import CC_ALGS
     base = alg if alg in CC_ALGS else sorted(CC_ALGS)[0]
+    # compact_lanes < B*R so every hook is traced through its live-prefix
+    # compaction path (ops/segment.py) — the geometry the production
+    # configs run, not just the padded fallback
     cfg = Config(cc_alg=base, batch_size=B, synth_table_size=64,
-                 req_per_query=R, query_pool_size=B, warmup_ticks=0)
+                 req_per_query=R, query_pool_size=B, warmup_ticks=0,
+                 compact_lanes=3 * B * R // 4)
     if base != alg:
         # a test-registered plugin outside the shipped CC_ALGS set (the
         # verifier traces whatever REGISTRY holds, not just built-ins)
@@ -32,7 +36,10 @@ def make_cfg(alg: str) -> Config:
 
 def arg_builders(cfg: Config) -> dict:
     i32 = jnp.int32
-    E = B * R
+    # entry-lane hooks are width-polymorphic (cc/base.py KERNEL_CONTRACT):
+    # trace them at the compacted width so a hook that silently assumes
+    # the padded B*R geometry fails verification
+    E = cfg.compact_width(B * R, B)
     return {
         "txn": lambda: TxnState.empty(B, R),
         "mask_b": lambda: jnp.zeros(B, dtype=bool),

@@ -101,6 +101,22 @@ _ALL = [
             "suppress with the invariant spelled out.",
     ),
     Rule(
+        id="PAD-WIDTH-SORT",
+        title="Padded-width sort where a compacted view exists",
+        rationale="This kernel scope builds a live-entry compaction view "
+                  "(ops/segment.compact_entries / cc/compact."
+                  "compact_access) yet a later lax.sort/sort_by chain "
+                  "runs on arrays NOT derived from it — i.e. at the full "
+                  "padded B*R width.  Sort cost scales with width; the "
+                  "whole point of the view is to run chains at the "
+                  "static live-prefix bucket K (PROFILE.md round 5).",
+        fix="Feed the sort the compacted arrays (the view's payload "
+            "outputs), or suppress with the reason the full width is "
+            "required (e.g. an expansion/unpermute back to B*R, a "
+            "fallback path for overflow, or a differently-keyed array "
+            "the view does not cover).",
+    ),
+    Rule(
         id="SUPPRESS-NO-REASON",
         title="Suppression without a justification",
         rationale="`# lint: disable=RULE` must record WHY the finding is "

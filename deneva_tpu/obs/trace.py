@@ -42,14 +42,18 @@ from deneva_tpu.engine.state import (STATUS_BACKOFF, STATUS_FREE,
 #: aborts), ``vabort``/``user_abort`` the reason breakdown, ``lock_wait``
 #: the tick's WAIT decisions (parked continuations).  The ``occ_*``
 #: columns are the end-of-tick slot-status histogram (they sum to B).
+#: ``live_entries``/``compact_ovf`` are the tick's deltas of the CC
+#: compaction counters (cc/base.py note_compaction): live entries seen by
+#: compacted kernels and live entries spilled past the static bucket.
 TRACE_COLUMNS = ("admit", "commit", "abort", "vabort", "user_abort",
                  "lock_wait", "occ_free", "occ_running", "occ_waiting",
-                 "occ_backoff")
+                 "occ_backoff", "live_entries", "compact_ovf")
 COL = {name: i for i, name in enumerate(TRACE_COLUMNS)}
 
 #: columns grouped into Perfetto counter tracks
 _FLOW = ("admit", "commit", "abort", "vabort", "user_abort", "lock_wait")
 _OCC = ("occ_free", "occ_running", "occ_waiting", "occ_backoff")
+_COMPACT = ("live_entries", "compact_ovf")
 
 
 def init_trace(cfg, lat_samples: int) -> dict:
@@ -68,7 +72,8 @@ def init_trace(cfg, lat_samples: int) -> dict:
 
 
 def record_tick(stats: dict, t, status, *, admit, commit, abort, vabort,
-                user_abort, lock_wait) -> dict:
+                user_abort, lock_wait, live_entries=0,
+                compact_ovf=0) -> dict:
     """Accumulate this tick's row (device side; no-op unless the buffer
     exists).  NOT warmup-gated — the timeline shows warmup dynamics too,
     so column sums match the warmup-gated [summary] counters exactly only
@@ -81,7 +86,9 @@ def record_tick(stats: dict, t, status, *, admit, commit, abort, vabort,
                      STATUS_BACKOFF)]
     row = jnp.stack([jnp.asarray(v, jnp.int32) for v in
                      (admit, commit, abort, vabort, user_abort, lock_wait)]
-                    + occ)
+                    + occ
+                    + [jnp.asarray(v, jnp.int32)
+                       for v in (live_entries, compact_ovf)])
     return {**stats,
             "arr_trace": buf.at[t % buf.shape[0]].add(
                 row, unique_indices=True)}
@@ -145,6 +152,10 @@ def to_chrome_trace(state_or_stats, path: str, n_ticks: int | None = None,
                            "pid": node,
                            "args": {c: int(buf[t, COL[c]])
                                     for c in _OCC}})
+            events.append({"name": "compaction", "ph": "C", "ts": ts,
+                           "pid": node,
+                           "args": {c: int(buf[t, COL[c]])
+                                    for c in _COMPACT}})
     doc = {"traceEvents": events, "displayTimeUnit": "ms",
            "metadata": {"tool": "deneva_tpu.obs.trace",
                         "columns": list(TRACE_COLUMNS),

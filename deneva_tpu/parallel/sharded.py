@@ -143,6 +143,10 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
         tables = state.tables
         t = state.tick
         measuring = t >= cfg.warmup_ticks
+        # compaction-counter baseline: the trace row records this tick's
+        # DELTA of the cumulative note_compaction counters (cc/base.py)
+        live_base = db.get("live_entry_cnt")
+        ovf_base = db.get("compact_overflow_cnt")
 
         # ---- 1. backoff expiry + admission (home-local) ----
         expire = (txn.status == STATUS_BACKOFF) & (txn.backoff_until <= t)
@@ -346,6 +350,23 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
         nR = n_nodes * cap
         Bv = nR + nE
 
+        # Owner-view compaction bucket: the virtual R==1 geometry defeats
+        # the auto live-width formula (it would return identity), yet the
+        # owner lanes are the sparsest view in the system — nR exchange
+        # slots padded for worst-case routing plus nE home lanes, with
+        # live entries ≈ one node's share of global live traffic, i.e.
+        # about the HOME bucket.  Pin the virtual-context compact_lanes
+        # to 2x the home bucket (margin for routing skew); spills force
+        # retries / stall the tick per cc/compact.py, counted in
+        # compact_overflow_cnt — never silent.  request_all plugins
+        # (CALVIN) keep the identity view, as at home.
+        vcfg = cfg
+        if (cfg.entry_compaction and cfg.compact_auto
+                and cfg.compact_lanes is None and not plugin.request_all):
+            home_k = cfg.compact_width(nE, B)
+            if 2 * home_k < Bv:
+                vcfg = cfg.replace(compact_lanes=2 * home_k)
+
         def owner_cat(recv_f, home_f, fill=0):
             loc = jnp.where(local_e, home_f,
                             jnp.asarray(fill, home_f.dtype))
@@ -383,7 +404,7 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
 
         vactive = o_live
         if normal:
-            dec, vdb = plugin.access(cfg, vdb, vtxn, vactive)
+            dec, vdb = plugin.access(vcfg, vdb, vtxn, vactive)
             vkw = {}
             if dly and plugin.commit_forward_push:
                 # validated-but-uncommitted entries (2PC prepare window)
@@ -392,7 +413,7 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
                 # and stop being squeeze targets (cc/maat.py)
                 vkw["prepared"] = (((o_flags >> 4) & 1 == 1) & o_live
                                    & ~o_fin)
-            votes, vdb = plugin.validate(cfg, vdb, vtxn, o_fin, t, **vkw)
+            votes, vdb = plugin.validate(vcfg, vdb, vtxn, o_fin, t, **vkw)
         else:
             # NOCC ladder: every request grants at its owner, every vote
             # is yes (row.cpp:199-206)
@@ -842,6 +863,10 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
         # network = entry-ticks shipped to remote owners this tick)
         stats = track_state_latencies(stats, txn, measuring)
         if cfg.trace_ticks > 0:
+            live_delta, ovf_delta = 0, 0
+            if "live_entry_cnt" in db:
+                live_delta = db["live_entry_cnt"] - live_base
+                ovf_delta = db["compact_overflow_cnt"] - ovf_base
             # per-shard row (the stats dict is per-node under shard_map, so
             # the fetched buffer stacks to (N, T, K): per-shard commit
             # counts — shard imbalance — come from the leading axis)
@@ -852,7 +877,8 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
                 abort=jnp.sum(abort_now.astype(jnp.int32)),
                 vabort=jnp.sum(vabort.astype(jnp.int32)),
                 user_abort=jnp.sum(ua.astype(jnp.int32)),
-                lock_wait=jnp.sum(wait.astype(jnp.int32)))
+                lock_wait=jnp.sum(wait.astype(jnp.int32)),
+                live_entries=live_delta, compact_ovf=ovf_delta)
         if dly:
             # with a real delay model, network time is the per-tick count
             # of txns blocked purely on message transit (integrates to

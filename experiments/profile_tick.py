@@ -121,7 +121,74 @@ def time_write_scatter(B, R=10, n_rows=1 << 24):
     return _time_loop(body, jnp.zeros(n_rows, jnp.int32))
 
 
+def time_engine_cfg(cfg):
+    eng = Engine(cfg)
+    st = eng.run_compiled(ITERS)
+    st = eng.run_compiled(ITERS, st)
+    jax.block_until_ready(st.stats["txn_cnt"])
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        st = eng.run_compiled(ITERS, st)
+        jax.block_until_ready(st.stats["txn_cnt"])
+        ts.append((time.perf_counter() - t0) / ITERS * 1e3)
+    return float(np.median(ts)), eng
+
+
+def sort_widths(eng):
+    """Histogram {operand_width: count} of lax.sort ops in the tick jaxpr
+    — the structural evidence that compacted chains run at K lanes."""
+    jaxpr = jax.make_jaxpr(eng._tick_fn)(eng.init_state())
+    widths: dict[int, int] = {}
+
+    def walk(jx):
+        for eqn in jx.eqns:
+            if eqn.primitive.name == "sort":
+                w = int(np.prod(eqn.invars[0].aval.shape or (1,)))
+                widths[w] = widths.get(w, 0) + 1
+            for v in eqn.params.values():
+                for sub in (v if isinstance(v, (list, tuple)) else (v,)):
+                    inner = getattr(sub, "jaxpr", None)
+                    if inner is not None:
+                        walk(inner)
+
+    walk(jaxpr.jaxpr)
+    return dict(sorted(widths.items()))
+
+
+def compact_ablation(B):
+    """Round-5 ablation: whole-tick ms with live-entry compaction on vs
+    off, plus the tick's sort-width histogram, for the sort-bound cells
+    (MAAT/MVCC YCSB + the TPC-C MVCC cell)."""
+    ycsb = dict(batch_size=B, synth_table_size=1 << 24, req_per_query=10,
+                zipf_theta=0.6, tup_read_perc=0.5, query_pool_size=1 << 16,
+                warmup_ticks=0, backoff=True, acquire_window=1,
+                admit_cap=max(B // 8, 1))
+    tpcc = dict(workload="TPCC", cc_alg="MVCC", batch_size=B, num_wh=64,
+                cust_per_dist=2000, max_items=1024, query_pool_size=1 << 16,
+                warmup_ticks=0, admit_cap=max(B // 8, 1))
+    cells = [("MAAT/ycsb", dict(cc_alg="MAAT", **ycsb)),
+             ("MVCC/ycsb", dict(cc_alg="MVCC", **ycsb)),
+             ("TPCC/mvcc", tpcc)]
+    print(f"{'cell':>10} {'on(ms)':>8} {'off(ms)':>8} {'x':>5}  "
+          "K-lane sorts -> padded sorts")
+    for name, kw in cells:
+        on_ms, on_eng = time_engine_cfg(Config(compact_auto=True, **kw))
+        off_ms, off_eng = time_engine_cfg(
+            Config(entry_compaction=False, **kw))
+        n = on_eng.cfg.batch_size * on_eng.pool.max_req
+        k = on_eng.cfg.compact_width(n, on_eng.cfg.batch_size)
+        w_on, w_off = sort_widths(on_eng), sort_widths(off_eng)
+        print(f"{name:>10} {on_ms:>8.3f} {off_ms:>8.3f} "
+              f"{off_ms / on_ms:>5.2f}  K={k}/n={n} on={w_on} off={w_off}",
+              flush=True)
+
+
 def main():
+    if "--compact" in sys.argv:
+        args = [a for a in sys.argv[1:] if a != "--compact"]
+        compact_ablation(int(args[0]) if args else 8192)
+        return
     Bs = [int(a) for a in sys.argv[1:]] or [2048, 4096, 8192, 16384]
     print(f"{'B':>6} {'tick':>7} {'nocc':>7} {'arb':>7} {'sort3':>7} "
           f"{'sort1':>7} {'wscat':>7}  (ms)")

@@ -12,6 +12,18 @@ if [ "$lint_rc" -ne 0 ]; then
     exit "$lint_rc"
 fi
 
+echo "== compaction parity smoke =="
+# one fast compacted-vs-padded bit-identity cell (the full 7-alg matrix
+# lives in tests/test_compaction.py and runs in the tier-1 gate below)
+env JAX_PLATFORMS=cpu python -m pytest \
+    "tests/test_compaction.py::test_ycsb_parity_compact_vs_padded[NO_WAIT]" \
+    -q -p no:cacheprovider
+parity_rc=$?
+if [ "$parity_rc" -ne 0 ]; then
+    echo "compaction parity smoke FAILED (rc=$parity_rc)"
+    exit "$parity_rc"
+fi
+
 echo "== tier-1 pytest =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

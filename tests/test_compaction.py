@@ -1,0 +1,191 @@
+"""Live-entry compaction tests (ops/segment.py + cc/compact.py wiring).
+
+Three layers:
+
+1. unit tests for the primitive triplet ``compact_entries`` /
+   ``expand_entries`` / ``overflow_mask`` (order preservation, round
+   trip, identity short-circuit, overflow accounting);
+2. the PR's headline guarantee: with a bucket K that never overflows,
+   every CC plugin's [summary] counters are BIT-IDENTICAL between the
+   compacted run and the padded (``entry_compaction=False``) run, on
+   YCSB and TPC-C, at a fixed pool seed;
+3. the spill discipline: a deliberately tiny K overflows, the spill is
+   COUNTED (``compact_overflow_cnt``) and the engine keeps committing —
+   overflowed work is deferred to retries, never silently dropped.
+
+Parity geometry notes: high contention (zipf 0.8 on 128 rows) keeps
+cursors low for the progressive-acquisition algorithms, and admit_cap=4
+staggers admission so OCC/MAAT finishing bursts stay under the bucket.
+K=96 suffices for the access-view algorithms; MAAT validates over ALL
+granted lanes of live txns (a wider view) and needs K=112.  CALVIN is
+request_all: its auto bucket is the full width (identity view) by
+design, so its pair pins that the flag itself changes nothing.
+
+A sub-padded bucket is OPT-IN (``compact_lanes`` / ``compact_auto``):
+the default config keeps the identity view, because a bucket that
+overflows changes the (legal) schedule and would break the exact
+sequential-oracle parity guarantee of PARITY.md.  The YCSB pairs here
+pin explicit lanes; the TPC-C pairs exercise the ``compact_auto``
+formula (K=1280 < n=2112 at this geometry, verified spill-free).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deneva_tpu.config import Config
+from deneva_tpu.engine.scheduler import Engine
+from deneva_tpu.ops import segment as seg
+
+# ---------------------------------------------------------------------------
+# 1. primitive unit tests
+
+
+def _rand_live(n, p, seed):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.random(n) < p)
+
+
+def test_compact_entries_preserves_live_order():
+    n, K = 64, 24
+    live = _rand_live(n, 0.3, 1)
+    pay = jnp.arange(n, dtype=jnp.int32) * 10
+    view, (cpay,) = seg.compact_entries(live, K, pay)
+    assert not view.identity and view.width == K and view.n == n
+    live_np = np.asarray(live)
+    want = np.asarray(pay)[live_np]          # original relative order
+    got = np.asarray(cpay)[np.asarray(view.live)]
+    assert list(got) == list(want[:K])
+    assert int(view.n_live) == int(live_np.sum())
+    assert int(view.overflow) == max(int(live_np.sum()) - K, 0)
+
+
+def test_expand_entries_round_trip():
+    n, K = 48, 32
+    live = _rand_live(n, 0.4, 2)
+    assert int(jnp.sum(live.astype(jnp.int32))) <= K
+    vals = jnp.arange(n, dtype=jnp.int32) + 100
+    flags = _rand_live(n, 0.5, 3)
+    view, (cv, cf) = seg.compact_entries(live, K, vals, flags)
+    assert cf.dtype == jnp.bool_             # bools convert back
+    ev, ef = seg.expand_entries(view, cv, cf, fill=0)
+    live_np = np.asarray(live)
+    np.testing.assert_array_equal(np.asarray(ev)[live_np],
+                                  np.asarray(vals)[live_np])
+    np.testing.assert_array_equal(np.asarray(ef)[live_np],
+                                  np.asarray(flags)[live_np])
+
+
+def test_identity_short_circuit():
+    live = _rand_live(16, 0.5, 4)
+    pay = jnp.arange(16, dtype=jnp.int32)
+    view, (out,) = seg.compact_entries(live, 16, pay)
+    assert view.identity and view.width == 16
+    assert out is pay                        # no sort emitted
+    (back,) = seg.expand_entries(view, out)
+    assert back is out
+    assert not bool(jnp.any(seg.overflow_mask(live, 16)))
+
+
+def test_bucket_is_opt_in():
+    # no opt-in -> padded width (identity view): the default schedule is
+    # bit-identical to the uncompacted engine, PARITY.md stays exact
+    assert Config(cc_alg="NO_WAIT").compact_width(2560, 256) == 2560
+    # compact_auto engages the cursor-model formula: ceil(10/2) + 1 = 6
+    cfg = Config(cc_alg="NO_WAIT", compact_auto=True)
+    assert cfg.compact_width(2560, 256) == 1536
+    # explicit lanes take precedence and are capped at n
+    cfg = Config(cc_alg="NO_WAIT", compact_lanes=400)
+    assert cfg.compact_width(2560, 256) == 400
+    assert cfg.compact_width(320, 32) == 320
+
+
+def test_overflow_mask_marks_live_tail():
+    live = jnp.asarray([1, 0, 1, 1, 0, 1, 1, 0], bool)
+    ovf = np.asarray(seg.overflow_mask(live, 3))
+    # live ranks: idx 0->0, 2->1, 3->2, 5->3, 6->4; K=3 spills ranks 3,4
+    assert list(np.nonzero(ovf)[0]) == [5, 6]
+    view, _ = seg.compact_entries(live, 3, jnp.arange(8, dtype=jnp.int32))
+    assert int(view.overflow) == 2
+
+
+# ---------------------------------------------------------------------------
+# 2. compacted vs padded bit-identical [summary]
+
+YCSB_KW = dict(batch_size=16, req_per_query=8, synth_table_size=128,
+               zipf_theta=0.8, query_pool_size=256, admit_cap=4,
+               max_ticks=10**6, warmup_ticks=0)
+
+#: per-algorithm bucket; None = auto (CALVIN: request_all -> identity)
+YCSB_K = {"NO_WAIT": 96, "WAIT_DIE": 96, "TIMESTAMP": 96, "MVCC": 96,
+          "OCC": 96, "MAAT": 112, "CALVIN": None}
+
+TPCC_KW = dict(workload="TPCC", batch_size=64, num_wh=4, part_cnt=1,
+               node_cnt=1, query_pool_size=1024, cust_per_dist=1000,
+               max_items=128, perc_payment=0.5, admit_cap=16,
+               warmup_ticks=0)
+
+
+def _summary_pair(cfg_compact: Config, cfg_padded: Config, n_ticks: int):
+    out = []
+    for cfg in (cfg_compact, cfg_padded):
+        eng = Engine(cfg)
+        out.append(eng.summary(eng.run(n_ticks)))
+    return out
+
+
+def _assert_bit_identical(sc, sp, alg):
+    sc, sp = dict(sc), dict(sp)
+    ovf = sc.pop("compact_overflow_cnt", 0)
+    assert ovf == 0, \
+        f"{alg}: bucket overflowed ({ovf}) — " \
+        "parity only holds when nothing spilled"
+    # the compaction counters exist only on the opted-in side (the padded
+    # run builds no view); everything else must match bit-for-bit
+    sc.pop("live_entry_cnt", None)
+    assert "live_entry_cnt" not in sp
+    diff = {k: (sc[k], sp.get(k)) for k in sc if sc[k] != sp.get(k)}
+    assert not diff, f"{alg}: compacted vs padded summary diverged: {diff}"
+
+
+@pytest.mark.parametrize("alg", list(YCSB_K))
+def test_ycsb_parity_compact_vs_padded(alg):
+    k = YCSB_K[alg]
+    lanes = {} if k is None else {"compact_lanes": k}
+    sc, sp = _summary_pair(
+        Config(cc_alg=alg, **lanes, **YCSB_KW),
+        Config(cc_alg=alg, entry_compaction=False, **YCSB_KW),
+        n_ticks=200)
+    _assert_bit_identical(sc, sp, alg)
+    assert sc["txn_cnt"] > 0
+
+
+@pytest.mark.parametrize("alg", ["NO_WAIT", "WAIT_DIE", "TIMESTAMP",
+                                 "MVCC", "OCC", "MAAT", "CALVIN"])
+def test_tpcc_parity_compact_vs_padded(alg):
+    sc, sp = _summary_pair(
+        Config(cc_alg=alg, compact_auto=True, **TPCC_KW),
+        Config(cc_alg=alg, entry_compaction=False, **TPCC_KW),
+        n_ticks=60)
+    _assert_bit_identical(sc, sp, alg)
+    assert sc["txn_cnt"] > 0
+
+
+# ---------------------------------------------------------------------------
+# 3. overflow spill: counted, retried, never dropped
+
+
+@pytest.mark.parametrize("alg", ["NO_WAIT", "MAAT"])
+def test_tiny_bucket_spills_and_recovers(alg):
+    cfg = Config(cc_alg=alg, compact_lanes=8, **YCSB_KW)
+    eng = Engine(cfg)
+    s = eng.summary(eng.run(200))
+    assert s["compact_overflow_cnt"] > 0     # the bucket really spilled
+    assert s["txn_cnt"] > 0                  # and the engine still commits
+    # spilled txns were deferred (forced retry / stalled vote), so the
+    # books still balance: every admission either committed, aborted at
+    # least once, or is still in flight
+    in_flight = cfg.batch_size
+    assert s["local_txn_start_cnt"] <= (s["txn_cnt"]
+                                        + s["total_txn_abort_cnt"]
+                                        + in_flight)

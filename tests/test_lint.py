@@ -166,6 +166,36 @@ GOOD_SCATTER_ARANGE = """
         return db.at[jnp.arange(8, dtype=jnp.int32)].set(vals)
 """
 
+BAD_PAD_SORT = """
+    import jax
+    from deneva_tpu.ops import segment as seg
+
+    @jax.jit
+    def step(live, key, ts):
+        view, (ckey, cts) = seg.compact_entries(live, 8, key, ts)
+        padded = jax.lax.sort((key, ts), num_keys=1, is_stable=False)
+        return view, ckey, cts, padded
+"""
+
+GOOD_PAD_SORT_COMPACTED = """
+    import jax
+    from deneva_tpu.ops import segment as seg
+
+    @jax.jit
+    def step(live, key, ts):
+        view, (ckey, cts) = seg.compact_entries(live, 8, key, ts)
+        return jax.lax.sort((ckey, cts), num_keys=1, is_stable=False)
+"""
+
+GOOD_PAD_SORT_NO_VIEW = """
+    import jax
+
+    @jax.jit
+    def step(key, ts):
+        # no compaction view in scope: full-width sorts are fine
+        return jax.lax.sort((key, ts), num_keys=1, is_stable=False)
+"""
+
 
 @pytest.mark.parametrize("code,rule", [
     (BAD_TRACED_BRANCH, "TRACED-BRANCH"),
@@ -175,8 +205,9 @@ GOOD_SCATTER_ARANGE = """
     (BAD_DTYPE, "IMPLICIT-DTYPE"),
     (BAD_HOST, "HOST-CALL"),
     (BAD_SCATTER, "SCATTER-RACE"),
+    (BAD_PAD_SORT, "PAD-WIDTH-SORT"),
 ], ids=["traced-branch", "concretize-int", "concretize-item", "data-dep",
-        "implicit-dtype", "host-call", "scatter-race"])
+        "implicit-dtype", "host-call", "scatter-race", "pad-width-sort"])
 def test_bad_fixture_is_flagged(tmp_path, code, rule):
     assert rule in active_rules(lint_src(tmp_path, code))
 
@@ -184,8 +215,10 @@ def test_bad_fixture_is_flagged(tmp_path, code, rule):
 @pytest.mark.parametrize("code", [
     GOOD_TRACED_BRANCH, GOOD_DATA_DEP, GOOD_DTYPE, GOOD_HOST,
     GOOD_SCATTER_ADD, GOOD_SCATTER_UNIQUE, GOOD_SCATTER_ARANGE,
+    GOOD_PAD_SORT_COMPACTED, GOOD_PAD_SORT_NO_VIEW,
 ], ids=["where", "sized-nonzero", "explicit-dtype", "host-outside-kernel",
-        "commutative-add", "declared-unique", "arange-index"])
+        "commutative-add", "declared-unique", "arange-index",
+        "sort-on-compacted", "sort-without-view"])
 def test_good_fixture_is_clean(tmp_path, code):
     assert active_rules(lint_src(tmp_path, code)) == []
 

@@ -107,10 +107,10 @@ def test_chrome_trace_schema(tmp_path):
     counters = [e for e in doc["traceEvents"] if e.get("ph") == "C"]
     metas = [e for e in doc["traceEvents"] if e.get("ph") == "M"]
     assert len(metas) == 1 and metas[0]["name"] == "process_name"
-    assert len(counters) == 2 * 30      # txn flow + slot occupancy per tick
+    assert len(counters) == 3 * 30      # flow + occupancy + compaction per tick
     for e in counters:
         assert {"name", "ph", "ts", "pid", "args"} <= set(e)
-        assert e["name"] in ("txn flow", "slot occupancy")
+        assert e["name"] in ("txn flow", "slot occupancy", "compaction")
         assert all(isinstance(v, int) for v in e["args"].values())
     # flow counter events integrate to the same totals as the buffer
     commits = sum(e["args"]["commit"] for e in counters
