@@ -89,7 +89,19 @@ def run_cell(cfg: Config, n_ticks: int = 300, windows: int = 7):
         committed = int(np.asarray(state.stats["txn_cnt"])) - committed_before
         tputs.append(committed / dt)
         cpt.append(committed / n_ticks)
-    return float(np.median(tputs)), float(np.median(cpt))
+    return float(np.median(tputs)), float(np.median(cpt)), eng.summary(state)
+
+
+def _abort_fields(summary: dict) -> dict:
+    """Per-cell abort diagnostics for the bench JSON: the whole-run abort
+    rate plus the top-3 taxonomy reasons (obs/report.py; present only
+    when the cell ran with Config.abort_attribution)."""
+    from deneva_tpu.obs import report as obs_report
+    out = {"abort_rate": round(float(summary.get("abort_rate", 0.0)), 4)}
+    top = obs_report.top_reasons(summary, k=3)
+    if top:
+        out["top_abort_reasons"] = {name: cnt for name, cnt in top}
+    return out
 
 
 # small, CPU-friendly observed cell (the EXPERIMENTS.md smoke shape):
@@ -102,13 +114,19 @@ OBS_KW = dict(
 
 
 def run_obs(args) -> int:
-    """Observed run: trace + [prog] + phase profile on a small YCSB cell.
-    Returns a process exit code (non-zero when reconciliation fails)."""
+    """Observed run: trace + [prog] + phase profile on a small YCSB cell,
+    with the abort-attribution observatory ON (taxonomy counters, hashed
+    hot-key heatmap, waterfall + watchdog report from obs/report.py).
+    Returns a process exit code (non-zero when reconciliation fails or
+    the watchdog flags live-lock / spill storms / starved shards)."""
+    from deneva_tpu.obs import report as obs_report
     cfg = Config(
         cc_alg=args.cc_alg,
         trace_ticks=(args.trace_ticks or args.ticks) if args.trace else 0,
         prog_interval=args.prog_interval,
         profile=args.profile,
+        abort_attribution=True,
+        heatmap_bins=256,
         **OBS_KW)
     eng = Engine(cfg)
     t0 = time.perf_counter()
@@ -148,20 +166,31 @@ def run_obs(args) -> int:
         print(f"[obs] run record: {rec_path}")
     if eng.profiler is not None:
         print(f"[obs] phases: {json.dumps(eng.profiler.snapshot())}")
+    # waterfall + taxonomy + hot keys + watchdog (the obs smoke gate in
+    # scripts/check.sh fails on any finding via the exit bitmask)
+    rep = obs_report.build_report(
+        summary, timeline=(obs_trace.timeline(state) if args.trace
+                           else None),
+        stats=state.stats, topk=cfg.heatmap_topk)
+    print(obs_report.render_text(rep))
+    code |= rep["watchdog"]["exit_code"]
     return code
 
 
 def run_single_alg(alg: str):
     """--alg: the headline YCSB cell (faithful, acquire_window=1) under one
-    CC plugin, same one-line JSON shape as the full sweep."""
+    CC plugin, same one-line JSON shape as the full sweep.  Runs with
+    abort attribution on so the cell reports WHY it aborted."""
     per_chip_star = NORTH_STAR_CLUSTER / NORTH_STAR_CHIPS
-    tput, cpt = run_cell(Config(cc_alg=alg, acquire_window=1, **YCSB_KW))
+    tput, cpt, summ = run_cell(Config(cc_alg=alg, acquire_window=1,
+                                      abort_attribution=True, **YCSB_KW))
     print(json.dumps({
         "metric": f"ycsb_{alg.lower()}_zipf0.6_tput_faithful",
         "value": round(float(tput), 1),
         "unit": "committed_txns_per_sec",
         "vs_baseline": round(float(tput) / per_chip_star, 4),
         "commits_per_tick": round(float(cpt), 1),
+        **_abort_fields(summ),
         "note": "single-algorithm headline cell (--alg); acquire_window 1; "
                 "vs_baseline = value / (1M-cluster north star / 8 chips)",
     }))
@@ -169,22 +198,29 @@ def run_single_alg(alg: str):
 
 def main():
     per_chip_star = NORTH_STAR_CLUSTER / NORTH_STAR_CHIPS
-    faithful, _ = run_cell(Config(cc_alg="NO_WAIT", acquire_window=1,
-                                  **YCSB_KW))
-    greedy, _ = run_cell(Config(cc_alg="NO_WAIT", acquire_window=10,
-                                **YCSB_KW))
+    faithful, _, _ = run_cell(Config(cc_alg="NO_WAIT", acquire_window=1,
+                                     **YCSB_KW))
+    greedy, _, _ = run_cell(Config(cc_alg="NO_WAIT", acquire_window=10,
+                                   **YCSB_KW))
 
     # every algorithm's faithful cell + TPC-C, smaller measurement (the
-    # compile dominates; commits/tick is the stable number)
+    # compile dominates; commits/tick is the stable number).  These cells
+    # run attributed so the sweep reports each algorithm's abort rate and
+    # top-3 reasons; the two headline cells above stay unattributed (the
+    # metric of record is measured on the untouched default tick).
     algs = {}
     for alg in ("NO_WAIT", "WAIT_DIE", "TIMESTAMP", "MVCC", "OCC", "MAAT",
                 "CALVIN"):
-        t, c = run_cell(Config(cc_alg=alg, acquire_window=1, **YCSB_KW),
-                        n_ticks=200, windows=3)
-        algs[alg] = {"tput": round(t, 1), "commits_per_tick": round(c, 1)}
-    t, c = run_cell(Config(**TPCC_KW), n_ticks=100, windows=3)
+        t, c, summ = run_cell(Config(cc_alg=alg, acquire_window=1,
+                                     abort_attribution=True, **YCSB_KW),
+                              n_ticks=200, windows=3)
+        algs[alg] = {"tput": round(t, 1), "commits_per_tick": round(c, 1),
+                     **_abort_fields(summ)}
+    t, c, summ = run_cell(Config(abort_attribution=True, **TPCC_KW),
+                          n_ticks=100, windows=3)
     algs["TPCC_MVCC_64wh"] = {"tput": round(t, 1),
-                              "commits_per_tick": round(c, 1)}
+                              "commits_per_tick": round(c, 1),
+                              **_abort_fields(summ)}
 
     print(json.dumps({
         "metric": "ycsb_nowait_zipf0.6_tput_faithful",

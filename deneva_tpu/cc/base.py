@@ -74,6 +74,45 @@ KERNEL_CONTRACT: dict = {
 }
 
 
+# --- abort-reason taxonomy (the observatory's machine-readable registry) ---
+#: Every abort event the engine records is tagged with exactly one of
+#: these reasons; the per-reason counters partition the aggregates so
+#: sum(abort_<reason>_cnt) == total_txn_abort_cnt + vabort_cnt +
+#: user_abort_cnt holds exactly (a validation abort counts through both
+#: the total and the vabort site, mirroring how the aggregates overlap).
+#: Order is the wire format: codes are index+1 (0 = "no reason
+#: recorded") and the sharded engine ships a code in decision bits 4..7,
+#: so the registry must stay under 16 entries and is append-only.
+ABORT_REASONS = (
+    "nowait_conflict",      # NO_WAIT: requested row held incompatibly
+    "waitdie_wound",        # WAIT_DIE: younger requester dies
+    "ts_too_old_read",      # TIMESTAMP: read under a newer committed write
+    "ts_too_old_write",     # TIMESTAMP: write under a newer read/write ts
+    "mvcc_version_miss",    # MVCC: version evicted / pending prewrite lost
+    "occ_validation",       # OCC: read set intersects a committed write set
+    "maat_range_collapse",  # MAAT: [lower, upper) squeezed empty
+    "user_abort",           # workload logic rollback (TPC-C rbk)
+    "compact_spill",        # live-entry compaction bucket overflow retry
+    "backoff_reabort",      # re-abort on the first tick back from backoff
+    "route_overflow",       # sharded: per-(src,dst) route capacity abort
+    "other",                # unattributed (stays zero unless a plugin
+                            # emits an abort without tagging it)
+)
+#: reason name -> nonzero wire code
+REASON = {name: i + 1 for i, name in enumerate(ABORT_REASONS)}
+REASON_NONE = 0
+assert len(ABORT_REASONS) < 16, "reason codes must fit 4 decision bits"
+
+
+def static_reason(cfg, name: str, shape) -> "jnp.ndarray | None":
+    """Constant reason-lane array for plugins whose access aborts all
+    carry one code (None when the observatory is off — the engine then
+    classifies any abort as ``other``)."""
+    if not cfg.abort_attribution:
+        return None
+    return jnp.full(shape, REASON[name], dtype=jnp.int32)
+
+
 def compaction_counters(cfg) -> dict:
     """The two db scalars a plugin carries when the config opts into a
     live-prefix compaction bucket (ops/segment.py): ``live_entry_cnt``
@@ -110,11 +149,17 @@ class AccessDecision(NamedTuple):
     mutually exclusive, true only at requested access positions (the window
     [cursor, cursor+acquire_window)).  The engine advances each txn's cursor
     over its granted prefix and applies the wait/abort decision found at the
-    first non-granted requested access."""
+    first non-granted requested access.
+
+    ``reason`` — optional abort attribution (same shape, int32 REASON
+    codes, meaningful where ``abort``): None whenever the config leaves
+    ``abort_attribution`` off, so the default decision pytree keeps its
+    3-leaf contract shape (None contributes no leaf)."""
 
     grant: jnp.ndarray
     wait: jnp.ndarray
     abort: jnp.ndarray
+    reason: jnp.ndarray | None = None
 
 
 class CCPlugin:
@@ -137,6 +182,34 @@ class CCPlugin:
     #: so the debug invariant kernel may assert the lock matrix
     #: (engine/debug.py, row_lock.cpp:309-314).
     lock_based: bool = False
+
+    # --- abort attribution (ABORT_REASONS registry above) ---
+    #: registered reason names this plugin's ACCESS decisions can carry
+    #: (() for plugins that never abort at access: OCC/MAAT/CALVIN)
+    access_abort_reasons: tuple[str, ...] = ()
+    #: registered reason tagged on this plugin's validation (vote-no)
+    #: aborts; None for plugins whose validate never rejects
+    vabort_reason: str | None = None
+
+    def emitted_reasons(self, cfg: Config) -> frozenset:
+        """Every registered reason this plugin can emit under ``cfg`` —
+        the taxonomy-exhaustiveness contract tests assert against
+        (engine-level codes ride along: user aborts, backoff re-aborts,
+        compaction spill, sharded route overflow)."""
+        out = {"user_abort"}
+        if self.access_abort_reasons:
+            out |= set(self.access_abort_reasons)
+            out.add("backoff_reabort")
+        if self.vabort_reason:
+            out.add(self.vabort_reason)
+        if cfg.entry_compaction and not self.never_aborts \
+                and (cfg.compact_lanes is not None or cfg.compact_auto):
+            out.add("compact_spill")
+        if cfg.node_cnt > 1 and not self.never_aborts:
+            out.add("route_overflow")
+        for name in out:
+            assert name in REASON, name
+        return frozenset(out)
 
     # --- multi-shard support (deneva_tpu/parallel/sharded.py) ---
     #: db keys holding per-TXN-slot (B,) arrays that must travel with each

@@ -152,3 +152,26 @@ def finish_access(ac: AccessCompaction, req_e: jnp.ndarray,
     wait = jnp.where(ac.unsafe, req_e, wait)
     abort = abort & ~ac.unsafe
     return grant, wait, abort
+
+
+def finish_reason(ac: AccessCompaction, req_e: jnp.ndarray,
+                  reason, never_aborts: bool = False):
+    """Expand a width-K reason plane (AccessDecision.reason) the same way
+    ``finish_access`` expands its masks, restamping the spill semantics:
+    forced-retry lanes carry ``compact_spill`` (the abort the fold just
+    synthesized has nothing to do with the plugin's own rule).  A
+    never-aborting plugin spills to WAIT, and an ``unsafe`` tick aborts
+    nothing, so neither needs a restamp — the engine only reads reasons
+    where ``abort`` holds.  None (observatory off) passes through."""
+    # lint: disable-next=TRACED-BRANCH is-None STRUCTURE check: reason is None iff abort_attribution is off (static per config), never a traced-value branch
+    if reason is None:
+        return None
+    n = req_e.shape[0]
+    B = ac.ovf_b.shape[0]
+    (reason,) = seg.expand_entries(ac.view, reason)
+    if not never_aborts:
+        ovf_e = jnp.broadcast_to(ac.ovf_b[:, None], (B, n // B)).reshape(-1)
+        reason = jnp.where(req_e & ovf_e,
+                           jnp.int32(cc_base.REASON["compact_spill"]),
+                           reason)
+    return reason

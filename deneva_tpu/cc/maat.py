@@ -98,6 +98,9 @@ class Maat(CCPlugin):
     ship_access_tick = True
     commit_forward_push = True
     forward_push_fields = ("maat_lower", "maat_upper")
+    #: MAAT never aborts at access time; every CC abort is a validation
+    #: whose [lower, upper) range collapsed empty (maat_range_abort_cnt)
+    vabort_reason = "maat_range_collapse"
 
     def init_db(self, cfg: Config, n_rows: int, B: int, R: int) -> dict:
         db = {

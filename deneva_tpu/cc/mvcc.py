@@ -55,7 +55,7 @@ import jax
 import jax.numpy as jnp
 
 
-from deneva_tpu.cc.base import AccessDecision, CCPlugin
+from deneva_tpu.cc.base import AccessDecision, CCPlugin, static_reason
 from deneva_tpu.cc import compact as ccompact
 from deneva_tpu.config import Config
 from deneva_tpu.engine.state import (BIG_TS, NULL_KEY, TxnState,
@@ -67,6 +67,10 @@ from deneva_tpu.ops import segment as seg
 class Mvcc(CCPlugin):
     name = "MVCC"
     new_ts_on_restart = True
+    #: all MVCC access aborts are one family — the target version is
+    #: unreachable (evicted past the floor, or a later read already
+    #: observed it; module doc decision rules)
+    access_abort_reasons = ("mvcc_version_miss",)
 
     def init_db(self, cfg: Config, n_rows: int, B: int, R: int) -> dict:
         # rings are stored FLAT (n_rows * H,), addressed as key*H + slot:
@@ -169,8 +173,11 @@ class Mvcc(CCPlugin):
                                     ~r_abort & ~r_wait)
         wait_e = c.req & ~c.is_write & ~r_abort & r_wait
         abort_e = c.req & ~grant_e & ~wait_e
+        reason = static_reason(cfg, self.access_abort_reasons[0],
+                               abort_e.shape)
         grant_e, wait_e, abort_e = ccompact.finish_access(
             ac, ent.req, grant_e, wait_e, abort_e)
+        reason = ccompact.finish_reason(ac, ent.req, reason)
 
         # granted reads record their rts on the version they read;
         # scatter from the request lanes (grant only exists there)
@@ -186,7 +193,9 @@ class Mvcc(CCPlugin):
 
         return (AccessDecision(grant=grant_w2,
                                wait=wait_e.reshape(B, R),
-                               abort=abort_e.reshape(B, R)),
+                               abort=abort_e.reshape(B, R),
+                               reason=None if reason is None
+                               else reason.reshape(B, R)),
                 {**db, "r_ring": r_ring, "rts0": rts0})
 
     def on_commit(self, cfg: Config, db: dict, txn: TxnState, committed,

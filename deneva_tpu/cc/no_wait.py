@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from deneva_tpu.cc.base import AccessDecision, CCPlugin
+from deneva_tpu.cc.base import AccessDecision, CCPlugin, static_reason
 from deneva_tpu.cc import compact as ccompact
 from deneva_tpu.cc import twopl
 from deneva_tpu.config import Config, READ_UNCOMMITTED, READ_COMMITTED, NOLOCK
@@ -28,6 +28,10 @@ from deneva_tpu.engine.state import TxnState, make_entries, NULL_KEY
 class TwoPLPlugin(CCPlugin):
     policy = "NO_WAIT"
     lock_based = True
+    #: lock-family access aborts carry one policy code each: NO_WAIT's
+    #: conflict abort (row_lock.cpp:86-90) vs WAIT_DIE's wound
+    #: (row_lock.cpp:91-151); subclasses pin the registered name
+    access_abort_reasons = ("nowait_conflict",)
 
     def _window_path(self, cfg: Config) -> bool:
         """The sort-free window arbitration covers the common isolation
@@ -56,12 +60,18 @@ class TwoPLPlugin(CCPlugin):
             g, w, a = twopl.arbitrate_subticked(
                 txn, active, self.policy, cfg.sub_ticks,
                 read_locks_held=(cfg.isolation_level == SERIALIZABLE))
-            return AccessDecision(grant=g, wait=w, abort=a), db
+            return AccessDecision(
+                grant=g, wait=w, abort=a,
+                reason=static_reason(cfg, self.access_abort_reasons[0],
+                                     (B, R))), db
         if self._window_path(cfg):
             g, w, a, tmp = twopl.arbitrate_window(
                 txn, active, self.policy, db, cfg.acquire_window,
                 read_locks_held=(cfg.isolation_level != READ_COMMITTED))
-            return AccessDecision(grant=g, wait=w, abort=a), {**db, **tmp}
+            return AccessDecision(
+                grant=g, wait=w, abort=a,
+                reason=static_reason(cfg, self.access_abort_reasons[0],
+                                     (B, R))), {**db, **tmp}
 
         ent = make_entries(
             txn, active,
@@ -87,18 +97,26 @@ class TwoPLPlugin(CCPlugin):
         # compact_overflow_cnt (cc/compact.py)
         db, ac = ccompact.compact_access(cfg, db, ent, B, R)
         g, w, a = twopl.arbitrate(ac.ent, self.policy)
+        reason = static_reason(cfg, self.access_abort_reasons[0], a.shape)
         g, w, a = ccompact.finish_access(ac, ent.req, g, w, a)
+        reason = ccompact.finish_reason(ac, ent.req, reason)
+        # lint: disable-next=TRACED-BRANCH is-None STRUCTURE check: reason is None iff abort_attribution is off (static per config), never a traced-value branch
+        if reason is not None:
+            reason = reason.reshape(B, R)
         return AccessDecision(grant=g.reshape(B, R) | bypass,
                               wait=w.reshape(B, R),
-                              abort=a.reshape(B, R)), db
+                              abort=a.reshape(B, R),
+                              reason=reason), db
 
 
 class NoWait(TwoPLPlugin):
     name = "NO_WAIT"
     policy = "NO_WAIT"
+    access_abort_reasons = ("nowait_conflict",)
 
 
 class WaitDie(TwoPLPlugin):
     name = "WAIT_DIE"
     policy = "WAIT_DIE"
     new_ts_on_restart = False
+    access_abort_reasons = ("waitdie_wound",)

@@ -37,6 +37,9 @@ class Occ(CCPlugin):
     name = "OCC"
     new_ts_on_restart = True
     release_on_vabort = True   # prepare marks need the RFIN(abort) release
+    #: OCC never aborts at access time; every CC abort is a failed
+    #: backward validation (history or active-set check)
+    vabort_reason = "occ_validation"
 
     def init_db(self, cfg: Config, n_rows: int, B: int, R: int) -> dict:
         db = {**super().init_db(cfg, n_rows, B, R),

@@ -39,7 +39,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from deneva_tpu.cc.base import AccessDecision, CCPlugin
+from deneva_tpu.cc.base import REASON, AccessDecision, CCPlugin
 from deneva_tpu.cc import compact as ccompact
 from deneva_tpu.cc.twopl import ts_groups
 from deneva_tpu.config import Config
@@ -71,9 +71,20 @@ def _decide(key, ts, is_write, held, req, w_abort, r_abort):
     return grant, wait, abort
 
 
+def _rw_reason(cfg, is_write):
+    """T/O abort attribution: every abort is its lane's too-old rule, so
+    the code splits exactly on the access kind (reads die on wts, writes
+    on rts/wts — module doc decision rules)."""
+    if not cfg.abort_attribution:
+        return None
+    return jnp.where(is_write, jnp.int32(REASON["ts_too_old_write"]),
+                     jnp.int32(REASON["ts_too_old_read"]))
+
+
 class Timestamp(CCPlugin):
     name = "TIMESTAMP"
     new_ts_on_restart = True  # is_cc_new_timestamp(), worker_thread.cpp:492
+    access_abort_reasons = ("ts_too_old_read", "ts_too_old_write")
 
     def init_db(self, cfg: Config, n_rows: int, B: int, R: int) -> dict:
         return {
@@ -117,8 +128,10 @@ class Timestamp(CCPlugin):
         grant_e, wait_e, abort_e = _decide(
             ac.ent.key, ac.ent.ts, ac.ent.is_write, ac.ent.held, ac.ent.req,
             *ac.extras)
+        reason = _rw_reason(cfg, ac.ent.is_write)
         grant_e, wait_e, abort_e = ccompact.finish_access(
             ac, ent.req, grant_e, wait_e, abort_e)
+        reason = ccompact.finish_reason(ac, ent.req, reason)
 
         # granted reads advance rts immediately (row_ts.cpp:187-189);
         # scatter from the request lanes (grant is only ever set there)
@@ -130,7 +143,9 @@ class Timestamp(CCPlugin):
 
         return (AccessDecision(grant=grant_w,
                                wait=wait_e.reshape(B, R),
-                               abort=abort_e.reshape(B, R)),
+                               abort=abort_e.reshape(B, R),
+                               reason=None if reason is None
+                               else reason.reshape(B, R)),
                 {**db, "rts": rts})
 
     def _access_subticked(self, cfg: Config, db: dict, txn: TxnState,
@@ -187,7 +202,8 @@ class Timestamp(CCPlugin):
 
         rts = db["rts"].at[flat(txn.keys)].max(
             jnp.where(flat(G & ~txn.is_write), flat(ts_e), 0), mode="drop")
-        return (AccessDecision(grant=G, wait=Wt, abort=A),
+        return (AccessDecision(grant=G, wait=Wt, abort=A,
+                               reason=_rw_reason(cfg, txn.is_write)),
                 {**db, "rts": rts})
 
     def on_commit(self, cfg: Config, db: dict, txn: TxnState, committed,

@@ -276,6 +276,32 @@ class Config:
     #: per-tick plots (deneva_tpu/obs/trace.py).
     trace_ticks: int = 0
 
+    #: abort-attribution observatory (cc/base.py ABORT_REASONS +
+    #: obs/report.py): when True every abort event is tagged with a
+    #: registered reason code and the engine carries device-resident
+    #: per-reason counters (``abort_<reason>_cnt`` in [summary]) plus
+    #: per-txn ``arr_last_abort_reason`` / ``arr_last_abort_key``
+    #: columns; with ``trace_ticks > 0`` a per-tick per-reason delta
+    #: ring and a Chrome "abort reasons" counter track ride along.
+    #: Per-reason counts partition the aggregates exactly:
+    #: sum(abort_*_cnt) == total_txn_abort_cnt + vabort_cnt +
+    #: user_abort_cnt.  Off by default — the stats pytree and the
+    #: [summary] line stay byte-identical to an engine without the
+    #: observatory.
+    abort_attribution: bool = False
+
+    #: contention heatmap: hashed per-key conflict histogram bin count
+    #: (power of two; 0 = off).  Every WAIT/ABORT decision at a txn's
+    #: failing access adds 1 to bin knuth_hash(key) — commutative
+    #: ``.add`` scatters, race-free per LINT.md — alongside
+    #: per-partition conflict counters and wait-streak depth sampling
+    #: (``arr_conflict_hist`` / ``arr_conflict_key`` /
+    #: ``arr_part_conflict`` / ``arr_wait_depth_hist``; top-K report in
+    #: obs/report.py).  Not warmup-gated, like the trace ring.
+    heatmap_bins: int = 0
+    #: rows of the hot-key report (obs/report.py; host-side only)
+    heatmap_topk: int = 8
+
     #: emit a ``[prog]`` heartbeat line every this-many ticks during
     #: Engine.run / ShardedEngine.run (the PROG_TIMER dump,
     #: system/thread.cpp:86-105; deneva_tpu/obs/prog.py).  Each emission
@@ -320,6 +346,11 @@ class Config:
                 "AP needs worker/replica mesh halves"
             assert self.part_cnt == self.node_cnt // 2, \
                 "AP: partitions live on the worker half only"
+        # the conflict histogram hashes with a multiplicative shift, so
+        # the bin count must be a power of two (obs: engine heatmap)
+        assert self.heatmap_bins >= 0 and \
+            (self.heatmap_bins & (self.heatmap_bins - 1)) == 0, \
+            "heatmap_bins must be 0 or a power of two"
         if self.net_delay_ticks > 0:
             # delay models message transit between shards; a single node
             # has no remote accesses for it to act on

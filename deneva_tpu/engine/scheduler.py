@@ -31,6 +31,7 @@ import numpy as np
 
 from deneva_tpu import cc as cc_registry
 from deneva_tpu import workloads as wl_registry
+from deneva_tpu.cc import base as cc_base
 from deneva_tpu.config import Config
 from deneva_tpu.obs import trace as obs_trace
 from deneva_tpu.obs.prog import ProgressEmitter
@@ -86,6 +87,13 @@ STAT_KEYS_F32 = (
 #: ccl* percentiles); wraps, so it always holds the most recent commits
 LAT_SAMPLES = 1 << 14
 
+#: wait-streak depth histogram width (Config.heatmap_bins observatory):
+#: bucket d counts wait streaks that ended after exactly d consecutive
+#: WAIT ticks (d >= WAIT_DEPTH_BINS-1 clamps into the last bucket) — the
+#: tick-model proxy for wait-chain depth, since a txn parked d ticks sat
+#: behind a conflict chain that took d ticks to drain
+WAIT_DEPTH_BINS = 16
+
 
 def _zeros_stats(cfg: Config | None = None,
                  wr_ring_shape: tuple[int, int] | None = None) -> dict:
@@ -102,6 +110,30 @@ def _zeros_stats(cfg: Config | None = None,
         B, R = wr_ring_shape
         s["arr_wr_ring"] = jnp.full((4 * B, R), NULL_ROW, jnp.int32)
         s["wr_ring_cursor"] = jnp.zeros((), jnp.int32)
+    if cfg is not None and cfg.abort_attribution:
+        # per-reason abort taxonomy (cc/base.py ABORT_REASONS): one event
+        # counter per registered code, bumped at EXACTLY the sites that
+        # bump the aggregates and with the same masks, so
+        #   sum(abort_*_cnt) == total_txn_abort_cnt + vabort_cnt
+        #                       + user_abort_cnt
+        # holds exactly (a validation abort counts in both the vabort and
+        # total aggregates, and counts twice here too); plus per-slot
+        # last-abort attribution columns for post-mortem inspection
+        for name in cc_base.ABORT_REASONS:
+            s[f"abort_{name}_cnt"] = jnp.zeros((), jnp.int32)
+        s["arr_last_abort_reason"] = jnp.zeros(cfg.batch_size, jnp.int32)
+        s["arr_last_abort_key"] = jnp.full(cfg.batch_size, NULL_KEY,
+                                           jnp.int32)
+    if cfg is not None and cfg.heatmap_bins > 0:
+        # contention heatmap (Config.heatmap_bins): hashed per-key
+        # conflict histogram + a representative key per bin, per-partition
+        # conflict counters, and the wait-streak depth histogram
+        # (note_conflicts).  Trace-like: NOT warmup-gated.
+        s["arr_conflict_hist"] = jnp.zeros(cfg.heatmap_bins, jnp.int32)
+        s["arr_conflict_key"] = jnp.zeros(cfg.heatmap_bins, jnp.int32)
+        s["arr_part_conflict"] = jnp.zeros(cfg.part_cnt, jnp.int32)
+        s["arr_wait_streak"] = jnp.zeros(cfg.batch_size, jnp.int32)
+        s["arr_wait_depth_hist"] = jnp.zeros(WAIT_DEPTH_BINS, jnp.int32)
     if cfg is not None:
         # per-tick timeline ring (obs/trace.py); {} when trace_ticks == 0
         s.update(obs_trace.init_trace(cfg, LAT_SAMPLES))
@@ -206,6 +238,95 @@ def bump(stats: dict, key: str, amount, measuring) -> dict:
     system/helper.h:136-150)."""
     inc = jnp.where(measuring, amount, 0).astype(stats[key].dtype)
     return {**stats, key: stats[key] + inc}
+
+
+def _reason_hist(code_b, mask_b):
+    """(len(ABORT_REASONS),) event histogram of registered abort-reason
+    codes (cc/base.py REASON) over the masked lanes.  Code 0 (no
+    attribution recorded — e.g. a plugin path that returned no reason
+    plane) falls back to "other"; unregistered high codes clamp there
+    too, so the histogram total always equals the mask population."""
+    n = len(cc_base.ABORT_REASONS)
+    code = jnp.where(code_b <= 0, jnp.int32(cc_base.REASON["other"]),
+                     code_b)
+    code = jnp.where(mask_b, jnp.minimum(code, n), 0)
+    return jnp.zeros(n + 1, jnp.int32).at[code].add(1)[1:]
+
+
+def note_aborts(cfg: Config, stats: dict, code_b, mask_b,
+                measuring) -> dict:
+    """Bump the per-reason abort counters (and the tick's reason-trace
+    accumulator, which is NOT warmup-gated) for one abort-event
+    population.  Called at EXACTLY the sites that bump the aggregate
+    counters (total_txn_abort_cnt / vabort_cnt / user_abort_cnt), with
+    the same masks, so the taxonomy reconciles exactly against them.
+    Shared by both engines."""
+    if not cfg.abort_attribution:
+        return stats
+    hist = _reason_hist(code_b, mask_b)
+    for i, name in enumerate(cc_base.ABORT_REASONS):
+        stats = bump(stats, f"abort_{name}_cnt", hist[i], measuring)
+    if "arr_reason_tick" in stats:
+        stats = {**stats,
+                 "arr_reason_tick": stats["arr_reason_tick"] + hist}
+    return stats
+
+
+def note_last_abort(stats: dict, mask_b, code_b, key_b) -> dict:
+    """Per-slot last-abort attribution columns (present only when
+    Config.abort_attribution): the most recent abort's reason code and
+    the key of the failing access (NULL_KEY for whole-txn events —
+    validation and user aborts).  Shared by both engines."""
+    if "arr_last_abort_reason" not in stats:
+        return stats
+    return {**stats,
+            "arr_last_abort_reason": jnp.where(
+                mask_b, code_b, stats["arr_last_abort_reason"]),
+            "arr_last_abort_key": jnp.where(
+                mask_b, key_b, stats["arr_last_abort_key"])}
+
+
+def note_conflicts(cfg: Config, stats: dict, conflict_b, key_b,
+                   wait_b) -> dict:
+    """Contention-heatmap update for one tick (Config.heatmap_bins > 0):
+    ``conflict_b`` marks txns whose failing access hit CC friction this
+    tick (a WAIT park or an access abort) and ``key_b`` the key it hit.
+
+    Keys hash into the fixed-width histogram with the Knuth multiplicative
+    hash (2654435761 = 2^32 / phi, top log2(bins) bits), so adjacent hot
+    keys spread across bins; arr_conflict_key keeps one representative
+    (max) colliding key per bin for the host-side top-K report
+    (obs/report.py).  All scatters are commutative .add/.max with dead
+    lanes dropped out of bounds (LINT.md scatter-race discipline).  Not
+    warmup-gated — a profiling surface, not a [summary] stat.  Shared by
+    both engines."""
+    if cfg.heatmap_bins <= 0:
+        return stats
+    bins = cfg.heatmap_bins
+    log2 = bins.bit_length() - 1
+    if log2 == 0:
+        hidx = jnp.zeros_like(key_b)
+    else:
+        hidx = ((key_b.astype(jnp.uint32) * jnp.uint32(2654435761))
+                >> jnp.uint32(32 - log2)).astype(jnp.int32)
+    idx = jnp.where(conflict_b, hidx, bins)
+    pidx = jnp.where(conflict_b, key_b % cfg.part_cnt, cfg.part_cnt)
+    streak = stats["arr_wait_streak"]
+    # sample a wait streak's depth when it ENDS (grant, abort or commit
+    # the tick after the last park) — see WAIT_DEPTH_BINS
+    ended = (streak > 0) & ~wait_b
+    depth = jnp.minimum(streak, WAIT_DEPTH_BINS - 1)
+    return {**stats,
+            "arr_conflict_hist": stats["arr_conflict_hist"].at[idx].add(
+                1, mode="drop"),
+            "arr_conflict_key": stats["arr_conflict_key"].at[idx].max(
+                key_b, mode="drop"),
+            "arr_part_conflict": stats["arr_part_conflict"].at[pidx].add(
+                1, mode="drop"),
+            "arr_wait_depth_hist": stats["arr_wait_depth_hist"].at[
+                jnp.where(ended, depth, WAIT_DEPTH_BINS)].add(
+                    1, mode="drop"),
+            "arr_wait_streak": jnp.where(wait_b, streak + 1, 0)}
 
 
 def record_commit_latency(stats: dict, commit, t, start_tick,
@@ -318,6 +439,14 @@ def make_tick(cfg: Config, plugin, pool_dev: dict, workload=None):
     # commits at admission without executing
     normal = cfg.mode == MODE_NORMAL
     apply_writes = cfg.mode in (MODE_NORMAL, MODE_NOCC)
+    # abort-attribution static codes: a validation abort carries the
+    # plugin's declared validation-failure reason (cc/base.py
+    # vabort_reason; "other" for a plugin that vaborts without declaring
+    # one), a workload rollback always user_abort
+    vabort_code = jnp.int32(cc_base.REASON[plugin.vabort_reason]
+                            if plugin.vabort_reason
+                            else cc_base.REASON["other"])
+    ua_code = jnp.int32(cc_base.REASON["user_abort"])
 
     # jitted via jax.jit(self._tick_fn) -- an attribute reference the
     # static seed scan cannot see, hence the explicit marker:
@@ -331,6 +460,11 @@ def make_tick(cfg: Config, plugin, pool_dev: dict, workload=None):
         # DELTA of the cumulative note_compaction counters (cc/base.py)
         live_base = db.get("live_entry_cnt")
         ovf_base = db.get("compact_overflow_cnt")
+        if "arr_reason_tick" in stats:
+            # this tick's per-reason abort histogram, accumulated by
+            # note_aborts and recorded into the reason-trace ring below
+            stats = {**stats, "arr_reason_tick":
+                     jnp.zeros_like(stats["arr_reason_tick"])}
 
         # ---- 1. backoff expiry: restart aborted txns ----
         expire = (txn.status == STATUS_BACKOFF) & (txn.backoff_until <= t)
@@ -493,6 +627,17 @@ def make_tick(cfg: Config, plugin, pool_dev: dict, workload=None):
                          measuring)
             stats = bump(stats, "user_abort_cnt",
                          jnp.sum(ua.astype(jnp.int32)), measuring)
+            # reason taxonomy: one per-reason bump per aggregate bump
+            # above (vabort_cnt / user_abort_cnt), same masks
+            stats = note_aborts(cfg, stats,
+                                jnp.full((txn.B,), vabort_code, jnp.int32),
+                                vabort, measuring)
+            stats = note_aborts(cfg, stats,
+                                jnp.full((txn.B,), ua_code, jnp.int32),
+                                ua, measuring)
+            stats = note_last_abort(stats, vabort | ua,
+                                    jnp.where(ua, ua_code, vabort_code),
+                                    jnp.full((txn.B,), NULL_KEY, jnp.int32))
             txn = txn._replace(status=jnp.where(commit | ua, STATUS_FREE,
                                                 txn.status))
             return txn, db, data, tables, stats, commit, vabort, ua
@@ -536,7 +681,8 @@ def make_tick(cfg: Config, plugin, pool_dev: dict, workload=None):
             at_fail = lambda m: jnp.any(m & (ridx == fail_pos), axis=1)
             blocked = has_req & (new_cursor < txn.n_req)
             wait = blocked & at_fail(dec.wait)
-            abort_now = (blocked & at_fail(dec.abort)) | vabort
+            acc_fail = blocked & at_fail(dec.abort)
+            abort_now = acc_fail | vabort
 
             cursor = jnp.where(has_req & ~abort_now, new_cursor, txn.cursor)
             status = jnp.where(has_req & (new_cursor > txn.cursor),
@@ -548,6 +694,39 @@ def make_tick(cfg: Config, plugin, pool_dev: dict, workload=None):
             # abort processing: exponential backoff (abort_queue.cpp:26-82)
             stats = bump(stats, "total_txn_abort_cnt",
                          jnp.sum(abort_now.astype(jnp.int32)), measuring)
+            if cfg.abort_attribution or cfg.heatmap_bins > 0:
+                # key at the failing access: fail_pos is one-hot per row,
+                # so the masked sum is a gather-free row lookup
+                fail_key = jnp.sum(jnp.where(ridx == fail_pos, txn.keys, 0),
+                                   axis=1)
+            if cfg.abort_attribution:
+                # classify every abort event counted above: the plugin's
+                # reason code at the failing access (dec.reason is
+                # meaningful where dec.abort), overridden by
+                # backoff_reabort for a txn that died again in the very
+                # tick it woke from backoff (thrash signal — the retry
+                # never made progress), and by the plugin's validation
+                # code on vabort lanes from a preceding commit block
+                # lint: disable-next=TRACED-BRANCH is-None STRUCTURE check: reason is None iff the plugin carries no access codes (static per plugin+config), never a traced-value branch
+                if dec.reason is not None:
+                    code_b = jnp.max(
+                        jnp.where((ridx == fail_pos) & dec.abort,
+                                  dec.reason, 0), axis=1)
+                else:
+                    code_b = jnp.zeros(txn.B, jnp.int32)
+                reab = (txn.restarts > 0) & (txn.start_tick == t)
+                code_b = jnp.where(
+                    acc_fail & reab,
+                    jnp.int32(cc_base.REASON["backoff_reabort"]), code_b)
+                code_b = jnp.where(vabort, vabort_code, code_b)
+                stats = note_aborts(cfg, stats, code_b, abort_now,
+                                    measuring)
+                stats = note_last_abort(
+                    stats, abort_now, code_b,
+                    jnp.where(acc_fail, fail_key, NULL_KEY))
+            if cfg.heatmap_bins > 0:
+                stats = note_conflicts(cfg, stats, wait | acc_fail,
+                                       fail_key, wait)
             penalty = _penalty(txn.restarts)
             status = jnp.where(abort_now, STATUS_BACKOFF, status)
             cursor = jnp.where(abort_now, 0, cursor)
@@ -591,9 +770,14 @@ def make_tick(cfg: Config, plugin, pool_dev: dict, workload=None):
                 txn, db, data, tables, stats)
             abort_total = abort_now | vabort
             # validation aborts enter backoff here (the access block has
-            # already run); counted once, like the pre-ordering path
+            # already run); counted once, like the pre-ordering path —
+            # with the matching per-reason bump so the reconciliation
+            # identity holds in this ordering too
             stats = bump(stats, "total_txn_abort_cnt",
                          jnp.sum(vabort.astype(jnp.int32)), measuring)
+            stats = note_aborts(cfg, stats,
+                                jnp.full((txn.B,), vabort_code, jnp.int32),
+                                vabort, measuring)
             txn = txn._replace(
                 status=jnp.where(vabort, STATUS_BACKOFF, txn.status),
                 cursor=jnp.where(vabort, 0, txn.cursor),
@@ -620,6 +804,7 @@ def make_tick(cfg: Config, plugin, pool_dev: dict, workload=None):
                 user_abort=jnp.sum(ua.astype(jnp.int32)),
                 lock_wait=jnp.sum(wait.astype(jnp.int32)),
                 live_entries=live_delta, compact_ovf=ovf_delta)
+            stats = obs_trace.record_reasons(stats, t)
 
         # ts wraparound guard: only relative order matters, and every live
         # txn's ts lies within [ts_counter - horizon, ts_counter], so rebase

@@ -23,10 +23,11 @@ def make_cfg(alg: str) -> Config:
     base = alg if alg in CC_ALGS else sorted(CC_ALGS)[0]
     # compact_lanes < B*R so every hook is traced through its live-prefix
     # compaction path (ops/segment.py) — the geometry the production
-    # configs run, not just the padded fallback
+    # configs run, not just the padded fallback; abort_attribution on so
+    # the reason-lane channel (AccessDecision.reason) is verified too
     cfg = Config(cc_alg=base, batch_size=B, synth_table_size=64,
                  req_per_query=R, query_pool_size=B, warmup_ticks=0,
-                 compact_lanes=3 * B * R // 4)
+                 compact_lanes=3 * B * R // 4, abort_attribution=True)
     if base != alg:
         # a test-registered plugin outside the shipped CC_ALGS set (the
         # verifier traces whatever REGISTRY holds, not just built-ins)
@@ -88,12 +89,19 @@ def check_output(kind: str, value, db_sig) -> str | None:
         return None
     if kind == "decision":
         leaves = jax.tree_util.tree_leaves(value)
-        if len(leaves) != 3:
+        if len(leaves) not in (3, 4):
             return (f"decision: expected 3 (B, R) masks "
-                    f"(grant, wait, abort), got {len(leaves)} leaves")
+                    f"(grant, wait, abort) plus an optional int32 "
+                    f"reason plane, got {len(leaves)} leaves")
         for nm, v in zip(("grant", "wait", "abort"), leaves):
             if tuple(v.shape) != (B, R) or jnp.dtype(v.dtype) != bool:
                 return (f"decision.{nm}: want (B, R)=({B}, {R}) bool, "
+                        f"got {tuple(v.shape)} {jnp.dtype(v.dtype).name}")
+        if len(leaves) == 4:
+            v = leaves[3]
+            if tuple(v.shape) != (B, R) or \
+                    jnp.dtype(v.dtype) != jnp.int32:
+                return (f"decision.reason: want (B, R)=({B}, {R}) int32, "
                         f"got {tuple(v.shape)} {jnp.dtype(v.dtype).name}")
         return None
     if kind == "votes":

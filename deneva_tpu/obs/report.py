@@ -1,0 +1,320 @@
+"""Cluster waterfall + abort-attribution report ("where did the time go /
+why did we abort"), and the obs watchdog.
+
+Consumes the observatory's three data products:
+
+- the ``[summary]`` counter dict (``Engine.summary`` /
+  ``ShardedEngine.summary`` — the sharded one is already the bit-exact
+  psum over the node axis), including the ``abort_<reason>_cnt`` taxonomy
+  counters of ``Config.abort_attribution`` (cc/base.py ABORT_REASONS);
+- the optional per-tick timeline (``obs.trace.timeline`` or the
+  ``timeline`` field of a run record, obs/profiler.py);
+- the optional contention heatmap arrays of ``Config.heatmap_bins``
+  (``arr_conflict_hist`` / ``arr_conflict_key`` / ``arr_part_conflict`` /
+  ``arr_wait_depth_hist`` in the stats dict).
+
+Everything renders twice: :func:`render_text` for terminals and
+:func:`build_report` for machines (plain-JSON-serializable dict).
+
+The watchdog (:func:`watchdog`) turns the same inputs into CI-grade
+findings with a process exit bitmask::
+
+    RECONCILE (1)  counters fail their exact identities
+    LIVELOCK  (2)  a zero-commit window with live abort/admission churn
+    SPILL     (4)  compaction spill storm (forced-retry pressure)
+    STARVED   (8)  a shard committing nothing while the cluster commits
+
+CLI: ``python -m deneva_tpu.obs.report <run_record.json> [--json]``
+exits with the watchdog bitmask, so a CI stage can gate on it
+(scripts/check.sh does).
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+# watchdog finding flags (process exit bitmask)
+RECONCILE = 1
+LIVELOCK = 2
+SPILL = 4
+STARVED = 8
+
+#: a zero-commit run of at least this many ticks, with abort/admission
+#: churn inside it, is flagged as live-lock
+LIVELOCK_WINDOW = 16
+#: compaction spills above this fraction of (commits + aborts) are a storm
+SPILL_FRAC = 0.05
+
+#: the waterfall's phase rows: [summary] latency-decomposition integrals
+#: (engine/scheduler.py track_state_latencies; all in txn-slot-ticks) and
+#: the trace occupancy column each must integrate to (warmup_ticks == 0)
+_PHASES = (("process", "lat_process_time", "occ_running"),
+           ("cc_block", "lat_cc_block_time", "occ_waiting"),
+           ("abort_backoff", "lat_abort_time", "occ_backoff"),
+           ("network", "lat_network_time", None))
+
+
+def _reason_counts(summary: dict) -> dict:
+    from deneva_tpu.cc.base import ABORT_REASONS
+    return {name: int(summary[f"abort_{name}_cnt"])
+            for name in ABORT_REASONS
+            if f"abort_{name}_cnt" in summary}
+
+
+def top_reasons(summary: dict, k: int = 3) -> list:
+    """Top-k ``(reason, count)`` pairs, nonzero only, count-descending
+    (ties broken by registry order).  Empty when the run was not
+    attributed."""
+    rc = _reason_counts(summary)
+    ranked = sorted(rc.items(), key=lambda kv: -kv[1])
+    return [(n, c) for n, c in ranked[:k] if c > 0]
+
+
+def reconcile(summary: dict, timeline: dict | None = None) -> list:
+    """Exact-identity checks; returns a list of human-readable failure
+    strings (empty == all good).
+
+    - taxonomy: sum(abort_<reason>_cnt) == total_txn_abort_cnt
+      + vabort_cnt + user_abort_cnt (vaborts are counted at both their
+      own bump site and the total site, by construction — see
+      engine/scheduler.py note_aborts call sites);
+    - timeline: flow column sums == [summary] counters, and each
+      waterfall phase integral == its occupancy column sum (exact when
+      ``warmup_ticks == 0``; callers with warmup pass ``timeline=None``).
+    """
+    bad = []
+    rc = _reason_counts(summary)
+    if rc:
+        want = int(summary.get("total_txn_abort_cnt", 0)) \
+            + int(summary.get("vabort_cnt", 0)) \
+            + int(summary.get("user_abort_cnt", 0))
+        got = sum(rc.values())
+        if got != want:
+            bad.append(f"taxonomy: sum(abort_*_cnt)={got} != "
+                       f"total+vabort+user={want}")
+    if timeline is not None:
+        def colsum(col):
+            return int(np.asarray(timeline[col]).sum())
+        for col, key in (("commit", "txn_cnt"),
+                         ("abort", "total_txn_abort_cnt"),
+                         ("admit", "local_txn_start_cnt"),
+                         ("vabort", "vabort_cnt"),
+                         ("user_abort", "user_abort_cnt"),
+                         ("lock_wait", "twopl_wait_cnt")):
+            if col in timeline and key in summary:
+                got, want = colsum(col), int(summary[key])
+                if got != want:
+                    bad.append(f"timeline: sum({col})={got} != "
+                               f"{key}={want}")
+        for phase, key, col in _PHASES:
+            if col and col in timeline and key in summary:
+                got, want = colsum(col), int(summary[key])
+                if got != want:
+                    bad.append(f"waterfall: {phase} occupancy sum({col})="
+                               f"{got} != {key}={want}")
+        # per-reason series integrate to the taxonomy counters
+        for name, cnt in rc.items():
+            col = f"abort_{name}"
+            if col in timeline:
+                got = colsum(col)
+                if got != cnt:
+                    bad.append(f"timeline: sum({col})={got} != "
+                               f"abort_{name}_cnt={cnt}")
+    return bad
+
+
+def hot_keys(stats: dict, topk: int = 8) -> list:
+    """Top-k contended keys from the hashed conflict histogram
+    (``Config.heatmap_bins``); list of ``{"key", "hits"}`` dicts,
+    hits-descending.  The per-bin key is the LARGEST key that hashed into
+    the bin (a representative, exact unless keys collide in the bin);
+    sharded stacked ``(N, bins)`` arrays contribute per-node entries,
+    merged by key."""
+    if "arr_conflict_hist" not in stats:
+        return []
+    hist = np.asarray(stats["arr_conflict_hist"]).reshape(-1)
+    keys = np.asarray(stats["arr_conflict_key"]).reshape(-1)
+    agg = {}
+    for k, h in zip(keys.tolist(), hist.tolist()):
+        if h > 0:
+            agg[k] = agg.get(k, 0) + h
+    ranked = sorted(agg.items(), key=lambda kv: (-kv[1], kv[0]))
+    return [{"key": int(k), "hits": int(h)} for k, h in ranked[:topk]]
+
+
+def build_report(summary: dict, timeline: dict | None = None,
+                 stats: dict | None = None, topk: int = 8) -> dict:
+    """The machine-readable waterfall: phases (slot-ticks + share),
+    throughput, the abort taxonomy, hot keys / per-partition conflicts /
+    wait-depth histogram (when the run kept a heatmap), reconciliation
+    failures and watchdog findings."""
+    phases = {}
+    total = 0
+    for phase, key, _ in _PHASES:
+        v = int(summary.get(key, 0))
+        phases[phase] = v
+        total += v
+    commits = int(summary.get("txn_cnt", 0))
+    aborts = int(summary.get("total_txn_abort_cnt", 0))
+    rep = {
+        "ticks": int(summary.get("measured_ticks", 0)),
+        "commits": commits,
+        "aborts": aborts,
+        "abort_rate": float(summary.get(
+            "abort_rate", aborts / max(aborts + commits, 1))),
+        "phases": phases,
+        "phase_ticks_total": total,
+        "abort_reasons": _reason_counts(summary),
+        "top_reasons": top_reasons(summary, k=topk),
+    }
+    if stats is not None:
+        rep["hot_keys"] = hot_keys(stats, topk=topk)
+        if "arr_part_conflict" in stats:
+            pc = np.asarray(stats["arr_part_conflict"])
+            rep["part_conflicts"] = pc.reshape(-1, pc.shape[-1]) \
+                                      .sum(axis=0).tolist() \
+                if pc.ndim > 1 else pc.tolist()
+        if "arr_wait_depth_hist" in stats:
+            wd = np.asarray(stats["arr_wait_depth_hist"])
+            rep["wait_depth_hist"] = wd.reshape(-1, wd.shape[-1]) \
+                                       .sum(axis=0).tolist() \
+                if wd.ndim > 1 else wd.tolist()
+    rep["reconcile_failures"] = reconcile(summary, timeline)
+    findings, code = watchdog(summary, timeline,
+                              precomputed_reconcile=rep["reconcile_failures"])
+    rep["watchdog"] = {"exit_code": code, "findings": findings}
+    return rep
+
+
+def watchdog(summary: dict, timeline: dict | None = None,
+             precomputed_reconcile: list | None = None) -> tuple:
+    """(findings, exit_bitmask).  Each finding is ``(FLAG_NAME, message)``;
+    the bitmask ORs RECONCILE/LIVELOCK/SPILL/STARVED."""
+    findings = []
+    code = 0
+
+    rec = (reconcile(summary, timeline)
+           if precomputed_reconcile is None else precomputed_reconcile)
+    for msg in rec:
+        findings.append(("RECONCILE", msg))
+        code |= RECONCILE
+
+    commits = int(summary.get("txn_cnt", 0))
+    aborts = int(summary.get("total_txn_abort_cnt", 0))
+    if timeline is not None and "commit" in timeline:
+        cm = np.asarray(timeline["commit"])
+        ab = np.asarray(timeline.get("abort", np.zeros_like(cm)))
+        ad = np.asarray(timeline.get("admit", np.zeros_like(cm)))
+        if cm.ndim > 1:                   # (N, T) per-shard view
+            per_shard = cm.sum(axis=1)
+            if commits > 0 and (per_shard == 0).any():
+                idle = np.nonzero(per_shard == 0)[0].tolist()
+                findings.append(
+                    ("STARVED", f"shards {idle} committed 0 txns while "
+                                f"the cluster committed {commits}"))
+                code |= STARVED
+            cm, ab, ad = cm.sum(axis=0), ab.sum(axis=0), ad.sum(axis=0)
+        # longest zero-commit streak with churn (aborts or admissions
+        # firing inside it): the live-lock signature
+        streak = best = churn = best_churn = 0
+        for c, a, d in zip(cm.tolist(), ab.tolist(), ad.tolist()):
+            if c == 0:
+                streak += 1
+                churn += a + d
+                if streak > best:
+                    best, best_churn = streak, churn
+            else:
+                streak = churn = 0
+        if best >= LIVELOCK_WINDOW and best_churn > 0:
+            findings.append(
+                ("LIVELOCK", f"zero-commit window of {best} ticks with "
+                             f"{best_churn} aborts/admissions inside it"))
+            code |= LIVELOCK
+    elif commits == 0 and aborts > 0:
+        findings.append(("LIVELOCK",
+                         f"0 commits against {aborts} aborts"))
+        code |= LIVELOCK
+
+    spills = int(summary.get("abort_compact_spill_cnt", 0))
+    ovf = int(summary.get("compact_overflow_cnt", 0))
+    if max(spills, ovf) > SPILL_FRAC * max(commits + aborts, 1):
+        findings.append(
+            ("SPILL", f"compaction spill storm: spill_aborts={spills} "
+                      f"overflow={ovf} vs {commits + aborts} outcomes"))
+        code |= SPILL
+    return findings, code
+
+
+def render_text(rep: dict) -> str:
+    """Terminal waterfall (fixed-width bars, no color)."""
+    lines = []
+    total = max(rep["phase_ticks_total"], 1)
+    lines.append(f"[waterfall] where did the time go "
+                 f"({rep['phase_ticks_total']} txn-slot-ticks over "
+                 f"{rep['ticks']} ticks)")
+    for phase, v in rep["phases"].items():
+        frac = v / total
+        bar = "#" * int(round(frac * 40))
+        lines.append(f"  {phase:<14} {bar:<40} {v:>10} ({frac:6.1%})")
+    n_ab = sum(rep["abort_reasons"].values())
+    lines.append(f"[aborts] why did we abort "
+                 f"(rate {rep['abort_rate']:.3f}; {rep['commits']} commits"
+                 f" / {rep['aborts']} aborts)")
+    if rep["abort_reasons"]:
+        for name, c in sorted(rep["abort_reasons"].items(),
+                              key=lambda kv: -kv[1]):
+            if c == 0:
+                continue
+            frac = c / max(n_ab, 1)
+            bar = "#" * int(round(frac * 40))
+            lines.append(f"  {name:<20} {bar:<40} {c:>8} ({frac:6.1%})")
+    else:
+        lines.append("  (run without Config.abort_attribution "
+                     "-- no taxonomy)")
+    if rep.get("hot_keys"):
+        lines.append("[hotkeys] most contended rows "
+                     "(hashed heatmap representatives)")
+        for hk in rep["hot_keys"]:
+            lines.append(f"  key={hk['key']:<10} hits={hk['hits']}")
+    if rep.get("wait_depth_hist"):
+        wd = rep["wait_depth_hist"]
+        lines.append("[waitdepth] wait-streak length histogram "
+                     f"(ticks waited; last bin = >={len(wd) - 1}): "
+                     + " ".join(str(v) for v in wd))
+    for flag, msg in rep["watchdog"]["findings"]:
+        lines.append(f"[watchdog] {flag}: {msg}")
+    if not rep["watchdog"]["findings"]:
+        lines.append("[watchdog] clean")
+    return "\n".join(lines)
+
+
+def report_from_record(rec: dict) -> dict:
+    """Build the report from a run-record JSON document
+    (obs/profiler.py write_run_record)."""
+    return build_report(rec["summary"], rec.get("timeline"))
+
+
+def main(argv=None) -> int:
+    import argparse
+    p = argparse.ArgumentParser(
+        description="waterfall + abort-attribution report from a "
+                    "run record; exits with the watchdog bitmask")
+    p.add_argument("record", help="run_record JSON path "
+                                  "(obs/profiler.py write_run_record)")
+    p.add_argument("--json", action="store_true",
+                   help="print the machine-readable report instead")
+    args = p.parse_args(argv)
+    with open(args.record) as f:
+        rec = json.load(f)
+    rep = report_from_record(rec)
+    if args.json:
+        print(json.dumps(rep))
+    else:
+        print(render_text(rep))
+    return rep["watchdog"]["exit_code"]
+
+
+if __name__ == "__main__":           # pragma: no cover - CLI shim
+    raise SystemExit(main())

@@ -61,7 +61,7 @@ def init_trace(cfg, lat_samples: int) -> dict:
     (the disabled path carries nothing)."""
     if cfg.trace_ticks <= 0:
         return {}
-    return {
+    out = {
         "arr_trace": jnp.zeros((cfg.trace_ticks, len(TRACE_COLUMNS)),
                                jnp.int32),
         # lifetime companion ring: commit-latency samples also record
@@ -69,6 +69,18 @@ def init_trace(cfg, lat_samples: int) -> dict:
         # (record_commit_latency fills it; timeline_plot.py reads it)
         "arr_lat_start": jnp.zeros(lat_samples, jnp.int32),
     }
+    if cfg.abort_attribution:
+        # companion per-reason ring (one column per cc/base.py
+        # ABORT_REASONS code) kept SEPARATE from arr_trace so the
+        # TRACE_COLUMNS schema — and every consumer of it — is unchanged
+        # when attribution is off; arr_reason_tick is the tick-local
+        # accumulator the scheduler's note_aborts fills
+        from deneva_tpu.cc.base import ABORT_REASONS
+        n = len(ABORT_REASONS)
+        out["arr_reason_trace"] = jnp.zeros((cfg.trace_ticks, n),
+                                            jnp.int32)
+        out["arr_reason_tick"] = jnp.zeros(n, jnp.int32)
+    return out
 
 
 def record_tick(stats: dict, t, status, *, admit, commit, abort, vabort,
@@ -94,22 +106,60 @@ def record_tick(stats: dict, t, status, *, admit, commit, abort, vabort,
                 row, unique_indices=True)}
 
 
+def record_reasons(stats: dict, t) -> dict:
+    """Accumulate the tick's per-reason abort histogram (filled into
+    ``arr_reason_tick`` by engine/scheduler.py note_aborts) into the
+    reason ring.  Same wrap-and-accumulate discipline — and the same
+    warmup caveat — as :func:`record_tick`; no-op unless the run traces
+    with ``Config.abort_attribution``."""
+    if "arr_reason_trace" not in stats:
+        return stats
+    buf = stats["arr_reason_trace"]
+    return {**stats,
+            "arr_reason_trace": buf.at[t % buf.shape[0]].add(
+                stats["arr_reason_tick"], unique_indices=True)}
+
+
 def _buffer(state_or_stats) -> np.ndarray:
     stats = getattr(state_or_stats, "stats", state_or_stats)
     assert "arr_trace" in stats, "run with Config.trace_ticks > 0"
     return np.asarray(stats["arr_trace"])
 
 
+def _reason_buffer(state_or_stats) -> np.ndarray | None:
+    stats = getattr(state_or_stats, "stats", state_or_stats)
+    if "arr_reason_trace" not in stats:
+        return None
+    return np.asarray(stats["arr_reason_trace"])
+
+
+def _reason_names() -> tuple:
+    from deneva_tpu.cc.base import ABORT_REASONS
+    return tuple(f"abort_{name}" for name in ABORT_REASONS)
+
+
 def timeline(state_or_stats, per_shard: bool = False) -> dict:
     """Named numpy series, one ``(T,)`` array per column (sharded buffers
     sum the node axis for the cluster-wide view unless ``per_shard``,
-    which keeps them ``(N, T)``)."""
+    which keeps them ``(N, T)``).  Runs traced with
+    ``Config.abort_attribution`` additionally carry one ``abort_<reason>``
+    series per registered reason code."""
     a = _buffer(state_or_stats)
+    r = _reason_buffer(state_or_stats)
     if a.ndim == 3 and not per_shard:
         a = a.sum(axis=0)
+        r = r.sum(axis=0) if r is not None else None
     if a.ndim == 3:
-        return {name: a[:, :, i] for i, name in enumerate(TRACE_COLUMNS)}
-    return {name: a[:, i] for i, name in enumerate(TRACE_COLUMNS)}
+        out = {name: a[:, :, i] for i, name in enumerate(TRACE_COLUMNS)}
+        if r is not None:
+            out.update({name: r[:, :, i]
+                        for i, name in enumerate(_reason_names())})
+        return out
+    out = {name: a[:, i] for i, name in enumerate(TRACE_COLUMNS)}
+    if r is not None:
+        out.update({name: r[:, i]
+                    for i, name in enumerate(_reason_names())})
+    return out
 
 
 def totals(state_or_stats) -> dict:
@@ -118,7 +168,13 @@ def totals(state_or_stats) -> dict:
     commits/aborts/admissions when ``warmup_ticks == 0``."""
     a = _buffer(state_or_stats)
     flat = a.reshape(-1, a.shape[-1]).sum(axis=0)
-    return {name: int(flat[i]) for i, name in enumerate(TRACE_COLUMNS)}
+    out = {name: int(flat[i]) for i, name in enumerate(TRACE_COLUMNS)}
+    r = _reason_buffer(state_or_stats)
+    if r is not None:
+        rflat = r.reshape(-1, r.shape[-1]).sum(axis=0)
+        out.update({name: int(rflat[i])
+                    for i, name in enumerate(_reason_names())})
+    return out
 
 
 def to_chrome_trace(state_or_stats, path: str, n_ticks: int | None = None,
@@ -132,6 +188,11 @@ def to_chrome_trace(state_or_stats, path: str, n_ticks: int | None = None,
     plots; the default keeps tick units)."""
     a = _buffer(state_or_stats)
     shards = a[None] if a.ndim == 2 else a          # (N, T, K)
+    rbuf = _reason_buffer(state_or_stats)
+    rshards = None
+    if rbuf is not None:
+        rshards = rbuf[None] if rbuf.ndim == 2 else rbuf
+    rnames = _reason_names()
     N, T, _ = shards.shape
     if n_ticks is not None:
         T = min(T, int(n_ticks))
@@ -156,10 +217,19 @@ def to_chrome_trace(state_or_stats, path: str, n_ticks: int | None = None,
                            "pid": node,
                            "args": {c: int(buf[t, COL[c]])
                                     for c in _COMPACT}})
+            if rshards is not None:
+                # 4th counter track, present only for attribution runs
+                # (the 3-track schema above is a compatibility contract)
+                events.append({"name": "abort reasons", "ph": "C",
+                               "ts": ts, "pid": node,
+                               "args": {c: int(rshards[node][t, i])
+                                        for i, c in enumerate(rnames)}})
     doc = {"traceEvents": events, "displayTimeUnit": "ms",
            "metadata": {"tool": "deneva_tpu.obs.trace",
                         "columns": list(TRACE_COLUMNS),
                         "tick_us": tick_us, "shards": N, "ticks": T}}
+    if rshards is not None:
+        doc["metadata"]["reason_columns"] = list(rnames)
     with open(path, "w") as f:
         json.dump(doc, f)
     return path

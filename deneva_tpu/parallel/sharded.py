@@ -51,10 +51,12 @@ from deneva_tpu.compat import shard_map
 
 from deneva_tpu import cc as cc_registry
 from deneva_tpu import workloads as wl_registry
+from deneva_tpu.cc import base as cc_base
 from deneva_tpu.config import Config, TPCC
 from deneva_tpu.engine.scheduler import (STAT_KEYS_F32, STAT_KEYS_I32,  # noqa: E501
                                          _zeros_stats, append_log_ring,
-                                         bump, recon_defer,
+                                         bump, note_aborts, note_conflicts,
+                                         note_last_abort, recon_defer,
                                          record_commit_latency,
                                          track_parts_touched,
                                          track_state_latencies)
@@ -98,17 +100,23 @@ def _init_net(cfg: Config, B: int, R: int) -> dict:
     if cfg.net_delay_ticks <= 0:
         return {}
     big = lambda *s: jnp.full(s, BIG_TS, jnp.int32)
-    return {"launch": jnp.zeros(B, jnp.int32),
-            "grant_tick": big(B, R),
-            "abort_due": big(B),
-            "fin_ready": big(B),
-            "vote_tick": big(B),
-            "vote_ok": jnp.zeros(B, dtype=bool),
-            # per-entry owner votes latched with the round: an owner that
-            # voted yes keeps the txn VALIDATED/prepared in ITS view even
-            # when another owner's no-vote dooms the txn (the abort
-            # releases it only at the RFIN round)
-            "vote_e": jnp.zeros((B, R), dtype=bool)}
+    out = {"launch": jnp.zeros(B, jnp.int32),
+           "grant_tick": big(B, R),
+           "abort_due": big(B),
+           "fin_ready": big(B),
+           "vote_tick": big(B),
+           "vote_ok": jnp.zeros(B, dtype=bool),
+           # per-entry owner votes latched with the round: an owner that
+           # voted yes keeps the txn VALIDATED/prepared in ITS view even
+           # when another owner's no-vote dooms the txn (the abort
+           # releases it only at the RFIN round)
+           "vote_e": jnp.zeros((B, R), dtype=bool)}
+    if cfg.abort_attribution:
+        # the abort REASON latched with abort_due: the owner's code rides
+        # the decision word home, but applies (is counted) only when the
+        # delayed abort reaches the home state machine
+        out["abort_code"] = jnp.zeros(B, jnp.int32)
+    return out
 
 
 def _flags(iw, held, req, fin, prepared=None):
@@ -137,12 +145,23 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
     from deneva_tpu.config import MODE_NOCC, MODE_NORMAL, MODE_SIMPLE
     normal = cfg.mode == MODE_NORMAL
     apply_writes = cfg.mode in (MODE_NORMAL, MODE_NOCC)
+    # abort-taxonomy codes (cc/base.py REASON), static per plugin
+    vabort_code = jnp.int32(cc_base.REASON[plugin.vabort_reason]
+                            if plugin.vabort_reason
+                            else cc_base.REASON["other"])
+    ua_code = jnp.int32(cc_base.REASON["user_abort"])
+    route_code = jnp.int32(cc_base.REASON["route_overflow"])
+    reab_code = jnp.int32(cc_base.REASON["backoff_reabort"])
 
     def tick_fn(state: ShardState, node_id) -> ShardState:
         txn, db, data, stats = state.txn, state.db, state.data, state.stats
         tables = state.tables
         t = state.tick
         measuring = t >= cfg.warmup_ticks
+        if "arr_reason_tick" in stats:
+            # per-tick reason accumulator for the trace ring (obs/trace.py)
+            stats = {**stats, "arr_reason_tick":
+                     jnp.zeros_like(stats["arr_reason_tick"])}
         # compaction-counter baseline: the trace row records this tick's
         # DELTA of the cumulative note_compaction counters (cc/base.py)
         live_base = db.get("live_entry_cnt")
@@ -218,6 +237,8 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
             for k in ("abort_due", "fin_ready", "vote_tick"):
                 net[k] = jnp.where(reset, BIG_TS, net[k])
             net["vote_ok"] = jnp.where(reset, False, net["vote_ok"])
+            if "abort_code" in net:
+                net["abort_code"] = jnp.where(reset, 0, net["abort_code"])
             # per-entry transit cost: CALVIN pays D on every entry (the
             # sequencer's epoch batch reaches every scheduler one hop
             # later, sequencer.cpp:283-326 — deterministic interleaving
@@ -434,6 +455,13 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
                    | (dec.wait.reshape(-1).astype(jnp.int32) << 1)
                    | (dec.abort.reshape(-1).astype(jnp.int32) << 2)
                    | (votes.astype(jnp.int32) << 3))
+        # lint: disable-next=TRACED-BRANCH is-None STRUCTURE check: reason is None iff the plugin carries no access codes (static per plugin+config), never a traced-value branch
+        if cfg.abort_attribution and dec.reason is not None:
+            # the owner's abort reason rides the decision word home in
+            # bits 4..7 (cc/base.py keeps len(ABORT_REASONS) < 16 —
+            # asserted there), masked to actual abort lanes
+            decbits = decbits | (jnp.where(dec.abort.reshape(-1),
+                                           dec.reason.reshape(-1), 0) << 4)
         back = {"decbits": decbits[:nR].reshape(n_nodes, cap)}
         for f in plugin.txn_db_fields:
             back[f] = vdb[f][:nR].reshape(n_nodes, cap)
@@ -459,6 +487,7 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
         wait_e = ((decb >> 1) & 1) == 1
         abort_e = ((decb >> 2) & 1) == 1
         vote_e = ((decb >> 3) & 1) == 1
+        reason_e = (decb >> 4) & 15 if cfg.abort_attribution else None
         if dly:
             # the owner's grant took effect at its end (the row is locked /
             # the prewrite buffered from tick t), but the response reaches
@@ -541,9 +570,16 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
             # re-requests arbitrate meanwhile.
             abort_raw = blocked & at_fail(abort_e)
             rem_fail = jnp.any((delay_e > 0) & (ridx == fail_pos), axis=1)
+            latch_abt = abort_raw & (net["abort_due"] == BIG_TS)
             net["abort_due"] = jnp.where(
-                abort_raw & (net["abort_due"] == BIG_TS),
-                t + jnp.where(rem_fail, dly, 0), net["abort_due"])
+                latch_abt, t + jnp.where(rem_fail, dly, 0),
+                net["abort_due"])
+            if "abort_code" in net:
+                # latch the reason with the decision; counted at apply
+                code_raw = jnp.max(jnp.where((ridx == fail_pos) & abort_e,
+                                             reason_e, 0), axis=1)
+                net["abort_code"] = jnp.where(latch_abt, code_raw,
+                                              net["abort_code"])
             abort_now = (active & (net["abort_due"] <= t)) | vabort
 
             # network-wait decomposition (per-message network time the
@@ -809,6 +845,11 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
              & (ridx < txn.n_req[:, None])).astype(jnp.int32)), measuring)
         stats = bump(stats, "vabort_cnt",
                      jnp.sum(vabort.astype(jnp.int32)), measuring)
+        if cfg.abort_attribution:
+            # vabort partition: a genuine validation failure carries the
+            # plugin's vabort_reason; a routing-overflow kill is transport
+            vcode_b = jnp.where(vabort_apply, vabort_code, route_code)
+            stats = note_aborts(cfg, stats, vcode_b, vabort, measuring)
 
         stats = track_parts_touched(stats, txn, commit, n_parts, measuring)
         stats = record_commit_latency(stats, commit, t, txn.start_tick,
@@ -824,10 +865,41 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
                      measuring)
         stats = bump(stats, "user_abort_cnt",
                      jnp.sum(ua.astype(jnp.int32)), measuring)
+        if cfg.abort_attribution:
+            stats = note_aborts(cfg, stats,
+                                jnp.full((B,), ua_code, jnp.int32), ua,
+                                measuring)
         status = jnp.where(commit | ua, STATUS_FREE, status)
 
         stats = bump(stats, "total_txn_abort_cnt",
                      jnp.sum(abort_now.astype(jnp.int32)), measuring)
+        if cfg.abort_attribution or cfg.heatmap_bins > 0:
+            fail_key = jnp.sum(jnp.where(ridx == fail_pos, txn.keys, 0),
+                               axis=1)
+        if cfg.abort_attribution:
+            acc_ab = abort_now & ~vabort
+            if dly:
+                code_b = net["abort_code"]   # latched with abort_due
+            else:
+                code_b = jnp.max(jnp.where((ridx == fail_pos) & abort_e,
+                                           reason_e, 0), axis=1)
+            reab = (txn.restarts > 0) & (txn.start_tick == t)
+            code_b = jnp.where(acc_ab & reab, reab_code, code_b)
+            code_b = jnp.where(vabort,
+                               jnp.where(vabort_apply, vabort_code,
+                                         route_code), code_b)
+            stats = note_aborts(cfg, stats, code_b, abort_now, measuring)
+            stats = note_last_abort(
+                stats, abort_now | ua, jnp.where(ua, ua_code, code_b),
+                jnp.where(acc_ab, fail_key, NULL_KEY))
+        if cfg.heatmap_bins > 0:
+            # conflict events this tick: parked continuations + CC access
+            # denials (in net_delay mode the denial counts when it reaches
+            # home; the denied entry's cursor froze, so fail_key still
+            # addresses the contended row)
+            stats = note_conflicts(cfg, stats,
+                                   wait | (abort_now & ~vabort),
+                                   fail_key, wait)
         shift = jnp.minimum(txn.restarts, 16)
         penalty = jnp.where(
             jnp.asarray(cfg.backoff),
@@ -858,6 +930,8 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
             for k in ("abort_due", "fin_ready", "vote_tick"):
                 net[k] = jnp.where(done, BIG_TS, net[k])
             net["vote_ok"] = jnp.where(done, False, net["vote_ok"])
+            if "abort_code" in net:
+                net["abort_code"] = jnp.where(done, 0, net["abort_code"])
 
         # latency decomposition integrals (txn-ticks per end-of-tick state;
         # network = entry-ticks shipped to remote owners this tick)
@@ -879,6 +953,7 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
                 user_abort=jnp.sum(ua.astype(jnp.int32)),
                 lock_wait=jnp.sum(wait.astype(jnp.int32)),
                 live_entries=live_delta, compact_ovf=ovf_delta)
+            stats = obs_trace.record_reasons(stats, t)
         if dly:
             # with a real delay model, network time is the per-tick count
             # of txns blocked purely on message transit (integrates to
@@ -1017,6 +1092,7 @@ class ShardedEngine:
 
         self._spmd_tick = spmd_tick
         self._jit_tick = None
+        self._psum_fn = None     # lazy cluster-counter aggregator
         # host-side phase profiler (obs/profiler.py); None when disabled
         self.profiler = PhaseProfiler() if cfg.profile else None
 
@@ -1108,18 +1184,50 @@ class ShardedEngine:
             jax.block_until_ready(out)
         return out
 
+    def _cluster_counters(self, state: ShardState) -> dict:
+        """Device-side cluster reduction: every int32 scalar counter —
+        the engine aggregates (STAT_KEYS_I32), SHARD_STAT_KEYS, the
+        ``abort_*`` taxonomy of Config.abort_attribution and the CC
+        plugins' db ``_cnt`` scalars — is psum'd over the node axis in
+        ONE jitted shard_map, so the cluster summary is the bit-exact
+        integer sum of the per-shard counters: no host gather of N stats
+        dicts and no float re-summation of int counters.  float32 time
+        integrals stay host-summed in :meth:`summary` (their summation
+        order is then pinned, independent of mesh topology)."""
+        tree = {**{("stats", k): v for k, v in state.stats.items()
+                   if not k.startswith("arr_") and v.ndim == 1
+                   and v.dtype == jnp.int32},
+                **{("db", k): v for k, v in state.db.items()
+                   if k.endswith("_cnt") and v.ndim == 1
+                   and v.dtype == jnp.int32}}
+        if self._psum_fn is None:
+            spec = P(AXIS)
+
+            def agg(tr):
+                local = jax.tree.map(lambda x: x[0], tr)
+                out = {k: jax.lax.psum(v, AXIS) for k, v in local.items()}
+                return jax.tree.map(lambda x: x[None], out)
+
+            self._psum_fn = jax.jit(shard_map(
+                agg, mesh=self.mesh, in_specs=(spec,), out_specs=spec))
+        agg_out = self._psum_fn(tree)
+        return {k: int(np.asarray(v)[0]) for (_, k), v in agg_out.items()}
+
     def summary(self, state: ShardState, wall_seconds: float | None = None
                 ) -> dict:
         """Cluster-wide stats: per-node counters summed, like the scripts
-        summing per-node tput (plot_helper.py:49-68)."""
-        s = {k: float(np.asarray(v).sum()) for k, v in state.stats.items()
-             if not k.startswith("arr_")}
-        s = {k: int(v) if k in STAT_KEYS_I32 + SHARD_STAT_KEYS
-             + ("lat_ring_cursor",) else v for k, v in s.items()}
-        # CC-plugin counters (db 0-d-per-node scalars ending _cnt),
-        # summed across nodes like the per-thread stats merge
+        summing per-node tput (plot_helper.py:49-68).  Integer counters
+        come from the device-side psum (:meth:`_cluster_counters`)."""
+        s = self._cluster_counters(state)
+        s.update({k: float(np.asarray(v).sum())
+                  for k, v in state.stats.items()
+                  if not k.startswith("arr_") and k not in s})
+        # CC-plugin counters (db 0-d-per-node scalars ending _cnt) not
+        # already covered by the int32 psum, summed across nodes like the
+        # per-thread stats merge
         s.update({k: int(np.asarray(v).sum()) for k, v in state.db.items()
-                  if k.endswith("_cnt") and np.asarray(v).ndim <= 1})
+                  if k.endswith("_cnt") and np.asarray(v).ndim <= 1
+                  and k not in s})
         commits = max(s["txn_cnt"], 1)
         out = dict(s)
         out["measured_ticks"] = int(np.asarray(state.stats["measured_ticks"]

@@ -91,12 +91,26 @@ def reference_summary(s: dict, wall_seconds: float | None = None) -> dict:
     # prints maat_caseN_cnt=%ld, stats.cpp:907).  maat_case1/3 are the
     # reference families (maat.cpp:46-48,68-70); the maat_chain_*/
     # maat_range_abort/occ_*/mvcc_* names are this build's inventions
-    # (cc/maat.py init_db documents the mapping).
+    # (cc/maat.py init_db documents the mapping).  The fixed tuple pins
+    # the legacy key ORDER (the line is a byte-compatibility contract).
     for k in ("maat_case1_cnt", "maat_case3_cnt", "maat_chain_cap_cnt",
               "maat_chain_push_cnt", "maat_range_abort_cnt",
               "maat_chain_overflow_cnt", "occ_hist_abort_cnt",
               "occ_active_abort_cnt", "mvcc_tail_fold_cnt"):
         if k in s:
+            out[k] = s[k]
+    # ... then any OTHER per-algorithm / observatory counter passes
+    # through verbatim (sorted, after the pinned block): the abort_*
+    # taxonomy of Config.abort_attribution (cc/base.py ABORT_REASONS)
+    # and future plugin-private _cnt scalars.  Passthrough is
+    # PREFIX-restricted, not blanket ``_cnt``: engine aggregates like
+    # write_cnt/vabort_cnt/recon_cnt already map to reference names
+    # above, and a blanket rule would leak them into every default line,
+    # breaking byte-compatibility.
+    _VERBATIM_PREFIXES = ("abort_", "maat_", "occ_", "mvcc_", "calvin_")
+    for k in sorted(s):
+        if k.endswith("_cnt") and k.startswith(_VERBATIM_PREFIXES) \
+                and k not in out:
             out[k] = s[k]
     # reference-name ALIASES for the invented chain counters, so parsers
     # of reference-format summaries (stats.cpp:907 prints case1..6) keep

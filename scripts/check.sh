@@ -24,6 +24,26 @@ if [ "$parity_rc" -ne 0 ]; then
     exit "$parity_rc"
 fi
 
+echo "== obs smoke (waterfall + watchdog) =="
+# one small attributed+traced cell through bench.py's observed path: the
+# exit code ORs reconciliation failures with the obs watchdog bitmask
+# (RECONCILE=1 LIVELOCK=2 SPILL=4 STARVED=8, deneva_tpu/obs/report.py),
+# then the report CLI re-derives the same verdict from the run record
+obs_dir=$(mktemp -d)
+env JAX_PLATFORMS=cpu python bench.py --trace --profile --ticks 40 \
+    --out-dir "$obs_dir"
+obs_rc=$?
+if [ "$obs_rc" -eq 0 ]; then
+    env JAX_PLATFORMS=cpu python -m deneva_tpu.obs.report \
+        "$obs_dir"/run_*.json > /dev/null
+    obs_rc=$?
+fi
+rm -rf "$obs_dir"
+if [ "$obs_rc" -ne 0 ]; then
+    echo "obs smoke FAILED (watchdog/reconcile bitmask rc=$obs_rc)"
+    exit "$obs_rc"
+fi
+
 echo "== tier-1 pytest =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

@@ -180,6 +180,173 @@ def test_run_compiled_profiled():
     assert int(np.asarray(st.stats["measured_ticks"])) == 20
 
 
+# ---- abort attribution / contention observatory ---------------------------
+
+from deneva_tpu import cc as cc_registry                    # noqa: E402
+from deneva_tpu.cc.base import ABORT_REASONS                # noqa: E402
+from deneva_tpu.obs import report as obs_report             # noqa: E402
+
+ALGS = ("NO_WAIT", "WAIT_DIE", "TIMESTAMP", "MVCC", "OCC", "MAAT", "CALVIN")
+
+#: small attributed YCSB cell on the acceptance contention point (zipf 0.6)
+ATTR = dict(batch_size=64, synth_table_size=256, req_per_query=4,
+            zipf_theta=0.6, query_pool_size=512, warmup_ticks=0,
+            abort_attribution=True, heatmap_bins=64)
+
+
+def _reason_sum(s):
+    return sum(s[f"abort_{n}_cnt"] for n in ABORT_REASONS)
+
+
+@pytest.mark.parametrize("alg", ALGS)
+def test_taxonomy_exact_and_exhaustive(alg):
+    # per-reason counters must sum EXACTLY to the aggregate abort counters
+    # (vaborts count at both their own site and the total site — the
+    # identity is total + vabort + user), and every nonzero reason must be
+    # one the plugin declared it can emit under this config
+    cfg = Config(cc_alg=alg, **ATTR)
+    eng = Engine(cfg)
+    st = eng.run(40)
+    s = eng.summary(st)
+    assert _reason_sum(s) == (s["total_txn_abort_cnt"] + s["vabort_cnt"]
+                              + s["user_abort_cnt"])
+    emitted = {n for n in ABORT_REASONS if s[f"abort_{n}_cnt"] > 0}
+    assert emitted <= cc_registry.get(alg).emitted_reasons(cfg)
+    assert s["abort_other_cnt"] == 0      # every abort carries a real code
+
+
+def test_taxonomy_tpcc_user_aborts():
+    cfg = Config(workload="TPCC", cc_alg="NO_WAIT", batch_size=64,
+                 num_wh=4, cust_per_dist=1000, max_items=128,
+                 query_pool_size=256, warmup_ticks=0, tpcc_rbk_perc=0.5,
+                 abort_attribution=True)
+    eng = Engine(cfg)
+    st = eng.run(120)
+    s = eng.summary(st)
+    assert s["user_abort_cnt"] > 0        # rbk 50% must fire
+    assert s["abort_user_abort_cnt"] == s["user_abort_cnt"]
+    assert _reason_sum(s) == (s["total_txn_abort_cnt"] + s["vabort_cnt"]
+                              + s["user_abort_cnt"])
+
+
+def test_taxonomy_commit_after_access_ordering():
+    cfg = Config(cc_alg="OCC", commit_after_access=True, **ATTR)
+    eng = Engine(cfg)
+    st = eng.run(40)
+    s = eng.summary(st)
+    assert _reason_sum(s) == (s["total_txn_abort_cnt"] + s["vabort_cnt"]
+                              + s["user_abort_cnt"])
+    # each vabort is tagged at BOTH its own bump site and the total site
+    # (the identity's double count), so the reason counter reads 2x
+    assert s["abort_occ_validation_cnt"] == 2 * s["vabort_cnt"]
+
+
+def test_heatmap_invariant_and_hot_keys():
+    cfg = Config(cc_alg="NO_WAIT", **{**ATTR, "zipf_theta": 0.9})
+    eng = Engine(cfg)
+    st = eng.run(40)
+    s = eng.summary(st)
+    # every conflict event (parked continuation or CC access denial)
+    # lands exactly one histogram increment; vaborts are not key-local
+    hist = np.asarray(st.stats["arr_conflict_hist"])
+    assert hist.sum() == (s["twopl_wait_cnt"] + s["total_txn_abort_cnt"]
+                          - s["vabort_cnt"])
+    hk = obs_report.hot_keys(st.stats, topk=cfg.heatmap_topk)
+    assert len(hk) <= cfg.heatmap_topk
+    hits = [h["hits"] for h in hk]
+    assert hits == sorted(hits, reverse=True)
+    assert all(h["hits"] > 0 for h in hk)
+    # wait-depth histogram counts ended wait streaks (NO_WAIT never
+    # waits, so drive one through WAIT_DIE instead)
+    cfg2 = Config(cc_alg="WAIT_DIE", **{**ATTR, "zipf_theta": 0.9})
+    eng2 = Engine(cfg2)
+    st2 = eng2.run(40)
+    wd = np.asarray(st2.stats["arr_wait_depth_hist"])
+    assert wd.shape == (16,) and (wd >= 0).all() and wd.sum() > 0
+
+
+def test_summary_line_round_trips_with_abort_keys():
+    # satellite contract: reference_summary passes unknown abort_*
+    # counters through verbatim and parse_summary round-trips them
+    eng, st = run(**{k: v for k, v in ATTR.items()
+                     if k not in ("batch_size", "synth_table_size",
+                                  "query_pool_size")})
+    s = eng.summary(st)
+    line = eng.summary_line(st)
+    parsed = stats_mod.parse_summary(line)
+    for n in ABORT_REASONS:
+        assert parsed[f"abort_{n}_cnt"] == float(s[f"abort_{n}_cnt"])
+
+
+def test_chrome_trace_reason_track(tmp_path):
+    eng, st = run(trace_ticks=30, abort_attribution=True)
+    path = obs_trace.to_chrome_trace(st, str(tmp_path / "t.json"),
+                                     n_ticks=30)
+    with open(path) as f:
+        doc = json.load(f)
+    counters = [e for e in doc["traceEvents"] if e.get("ph") == "C"]
+    assert len(counters) == 4 * 30        # + the abort-reasons track
+    rtrack = [e for e in counters if e["name"] == "abort reasons"]
+    assert len(rtrack) == 30
+    assert doc["metadata"]["reason_columns"] == \
+        [f"abort_{n}" for n in ABORT_REASONS]
+    s = eng.summary(st)
+    for n in ABORT_REASONS:
+        got = sum(e["args"][f"abort_{n}"] for e in rtrack)
+        assert got == s[f"abort_{n}_cnt"]
+
+
+def test_attribution_off_carries_nothing():
+    eng, st = run()
+    s = eng.summary(st)
+    assert not any(k.startswith("abort_") and k.endswith("_cnt")
+                   for k in s)
+    for k in ("arr_last_abort_reason", "arr_conflict_hist",
+              "arr_wait_streak"):
+        assert k not in st.stats
+
+
+def test_waterfall_report_and_watchdog_clean():
+    eng, st = run(trace_ticks=64, abort_attribution=True, heatmap_bins=64)
+    s = eng.summary(st)
+    rep = obs_report.build_report(s, timeline=obs_trace.timeline(st),
+                                  stats=st.stats)
+    assert rep["reconcile_failures"] == []
+    assert rep["watchdog"]["exit_code"] == 0
+    assert rep["commits"] == s["txn_cnt"]
+    assert sum(rep["abort_reasons"].values()) == _reason_sum(s)
+    # phase rows reconcile with the [summary] latency decomposition
+    assert rep["phases"]["process"] == s["lat_process_time"]
+    assert rep["phases"]["cc_block"] == s["lat_cc_block_time"]
+    assert rep["phases"]["abort_backoff"] == s["lat_abort_time"]
+    text = obs_report.render_text(rep)
+    assert "[waterfall]" in text and "[watchdog] clean" in text
+
+
+def test_watchdog_flags():
+    # live-lock: zero commits against churn
+    live = {"txn_cnt": 0, "total_txn_abort_cnt": 50}
+    _, code = obs_report.watchdog(live)
+    assert code & obs_report.LIVELOCK
+    # starved shard: one shard idle on the per-shard commit series
+    tl = {"commit": np.array([[5] * 32, [0] * 32]),
+          "abort": np.zeros((2, 32), int),
+          "admit": np.zeros((2, 32), int)}
+    _, code = obs_report.watchdog({"txn_cnt": 160}, tl)
+    assert code & obs_report.STARVED
+    # spill storm from the taxonomy counter
+    _, code = obs_report.watchdog(
+        {"txn_cnt": 10, "total_txn_abort_cnt": 10,
+         "abort_compact_spill_cnt": 10})
+    assert code & obs_report.SPILL
+    # reconciliation breach
+    bad = {"txn_cnt": 1, "total_txn_abort_cnt": 3, "vabort_cnt": 0,
+           "user_abort_cnt": 0,
+           **{f"abort_{n}_cnt": 0 for n in ABORT_REASONS}}
+    _, code = obs_report.watchdog(bad)
+    assert code & obs_report.RECONCILE
+
+
 # ---- sharded --------------------------------------------------------------
 
 @pytest.mark.slow  # unlocked by the shard_map compat fix; over the tier-1 time budget
@@ -203,3 +370,34 @@ def test_sharded_trace_per_shard_commits():
     snap = eng.profiler.snapshot()
     assert snap["counters"]["jit_recompiles"] >= 1
     assert snap["phases"]["execute"]["count"] == 20
+
+
+@pytest.mark.slow  # multi-device shard_map cell, over the tier-1 time budget
+def test_sharded_reason_counters_bitexact_and_reconcile():
+    try:
+        from deneva_tpu.parallel.sharded import ShardedEngine
+    except ImportError as e:         # pragma: no cover - jax api drift
+        pytest.skip(f"sharded engine unavailable: {e}")
+    cfg = Config(cc_alg="NO_WAIT", node_cnt=2, part_cnt=2, batch_size=32,
+                 synth_table_size=1 << 10, req_per_query=4, zipf_theta=0.8,
+                 query_pool_size=512, warmup_ticks=0,
+                 abort_attribution=True, heatmap_bins=64, trace_ticks=32)
+    eng = ShardedEngine(cfg)
+    st = eng.run(25)
+    s = eng.summary(st)
+    # cluster counters (device psum) == host sum of per-shard counters,
+    # bit-exact, for every taxonomy counter and the aggregates
+    for n in ABORT_REASONS:
+        k = f"abort_{n}_cnt"
+        assert s[k] == int(np.asarray(st.stats[k]).sum())
+        assert isinstance(s[k], int)
+    for k in ("total_txn_abort_cnt", "vabort_cnt", "user_abort_cnt",
+              "txn_cnt"):
+        assert s[k] == int(np.asarray(st.stats[k]).sum())
+    assert _reason_sum(s) == (s["total_txn_abort_cnt"] + s["vabort_cnt"]
+                              + s["user_abort_cnt"])
+    # per-reason trace series stack per shard and integrate to the counters
+    tl = obs_trace.timeline(st)
+    rep = obs_report.build_report(s, timeline=tl, stats=st.stats)
+    assert rep["reconcile_failures"] == []
+    assert rep["watchdog"]["exit_code"] == 0
