@@ -24,11 +24,25 @@ runs ONE small observed YCSB cell through the obs subsystem (deneva_tpu/obs):
 [prog] heartbeats, a Perfetto-loadable Chrome trace, a phase-profile and a
 structured run record under --out-dir, plus a trace-vs-summary
 reconciliation check.  EXPERIMENTS.md documents the CPU smoke invocation.
+
+With ``--xmeter`` the script runs the compile & memory observatory smoke
+(obs/xmeter.py, Config.xmeter): a warmup window, then a BLOCKED steady
+window under the recompile sentinel — any post-warmup compile names its
+entry point and fails the run — plus the HBM footprint ledger reconciled
+against the compiled tick's own ``memory_analysis()`` and the generated
+per-kernel roofline table.  scripts/check.sh gates on its exit code.
+
+Every headline run additionally APPENDS one JSON line to
+``<out-dir>/bench_history.jsonl`` (unix time, git commit, config
+fingerprint, headline value, per-algorithm cells) — the trajectory that
+``python -m deneva_tpu.obs.regress`` gates against.  ``--no-history``
+skips the append (use for throwaway experiments).
 """
 
 import argparse
 import json
 import os
+import subprocess
 import time
 
 import jax
@@ -177,14 +191,101 @@ def run_obs(args) -> int:
     return code
 
 
-def run_single_alg(alg: str):
+def _git_commit() -> str | None:
+    try:
+        out = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
+                             capture_output=True, text=True, timeout=10)
+        return out.stdout.strip() or None if out.returncode == 0 else None
+    except OSError:  # pragma: no cover - no git binary
+        return None
+
+
+def _append_history(doc: dict, cfg: Config, out_dir: str = "results") -> str:
+    """Append this run's headline cells to ``<out-dir>/bench_history.jsonl``
+    — the append-only trajectory the regression gate
+    (``python -m deneva_tpu.obs.regress``) compares new snapshots against.
+    One line per run: unix time + git commit + config fingerprint for
+    provenance, the headline metric/value, and the per-algorithm cells
+    (regress gates on their chip-noise-immune ``commits_per_tick``)."""
+    rec = {
+        "unix_time": int(time.time()),
+        "commit": _git_commit(),
+        "config_fingerprint": obs_profiler.config_fingerprint(cfg),
+        "metric": doc["metric"],
+        "value": doc["value"],
+    }
+    if "commits_per_tick" in doc:
+        rec["commits_per_tick"] = doc["commits_per_tick"]
+    if "algs" in doc:
+        rec["algs"] = doc["algs"]
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, "bench_history.jsonl")
+    with open(path, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    return path
+
+
+def run_xmeter(args) -> int:
+    """--xmeter: compile & memory observatory smoke on the small observed
+    cell.  Warmup window, then mark_warm + blocked steady window — the
+    sentinel must count ZERO steady-state compiles; the ledger's carry
+    total must reconcile against the compiled tick's
+    ``memory_analysis()`` argument bytes within 1%.  Exit bitmask:
+    1 = post-warmup recompile, 2 = ledger reconcile failure."""
+    from deneva_tpu.obs import xmeter as obs_xmeter
+    cfg = Config(cc_alg=args.cc_alg, xmeter=True, **OBS_KW)
+    eng = Engine(cfg)
+    t0 = time.perf_counter()
+    state = eng.run(args.ticks)                # warmup: compiles land here
+    eng.xmeter.mark_warm()
+    eng.xmeter.block = True                    # wall-true per-call ms
+    state = eng.run(args.ticks, state)         # metered steady window
+    wall = time.perf_counter() - t0
+    summary = eng.summary(state, wall)
+    print(eng.summary_line(state, wall))
+
+    code = 0
+    viol = eng.xmeter.steady_violations()
+    if viol:
+        for v in viol:
+            print(f"[xmeter] RECOMPILE {v['entry']}: {v['signature']}")
+        code = 1
+    else:
+        cnt, ms = eng.xmeter.compile_totals()
+        print(f"[xmeter] steady state held: {cnt} warmup compiles "
+              f"({ms:.0f} ms), zero after mark_warm")
+
+    rows = eng.ledger(state)
+    analysis = eng.xmeter.analyze("tick")
+    rec = obs_xmeter.reconcile_ledger(rows, analysis)
+    print(f"[xmeter] ledger reconcile: carry={rec['carry_bytes']} "
+          f"executable argument={rec['argument_bytes']} "
+          f"ratio={rec['ratio']:.4f} {'OK' if rec['ok'] else 'MISMATCH'}")
+    if not rec["ok"]:
+        code |= 2
+    print(obs_xmeter.ledger_text(rows))
+    roof = eng.xmeter.roofline()
+    if roof:
+        print(obs_xmeter.roofline_markdown(roof))
+
+    record = obs_profiler.run_record(
+        cfg, summary, extra={"wall_seconds": wall,
+                             "xmeter": eng.xmeter.snapshot()})
+    rec_path = obs_profiler.write_run_record(record, out_dir=args.out_dir)
+    print(f"[obs] run record: {rec_path}")
+    return code
+
+
+def run_single_alg(alg: str, out_dir: str = "results",
+                   history: bool = True):
     """--alg: the headline YCSB cell (faithful, acquire_window=1) under one
     CC plugin, same one-line JSON shape as the full sweep.  Runs with
     abort attribution on so the cell reports WHY it aborted."""
     per_chip_star = NORTH_STAR_CLUSTER / NORTH_STAR_CHIPS
-    tput, cpt, summ = run_cell(Config(cc_alg=alg, acquire_window=1,
-                                      abort_attribution=True, **YCSB_KW))
-    print(json.dumps({
+    cfg = Config(cc_alg=alg, acquire_window=1,
+                 abort_attribution=True, **YCSB_KW)
+    tput, cpt, summ = run_cell(cfg)
+    doc = {
         "metric": f"ycsb_{alg.lower()}_zipf0.6_tput_faithful",
         "value": round(float(tput), 1),
         "unit": "committed_txns_per_sec",
@@ -193,10 +294,13 @@ def run_single_alg(alg: str):
         **_abort_fields(summ),
         "note": "single-algorithm headline cell (--alg); acquire_window 1; "
                 "vs_baseline = value / (1M-cluster north star / 8 chips)",
-    }))
+    }
+    print(json.dumps(doc))
+    if history:
+        _append_history(doc, cfg, out_dir)
 
 
-def main():
+def main(out_dir: str = "results", history: bool = True):
     per_chip_star = NORTH_STAR_CLUSTER / NORTH_STAR_CHIPS
     faithful, _, _ = run_cell(Config(cc_alg="NO_WAIT", acquire_window=1,
                                      **YCSB_KW))
@@ -222,7 +326,7 @@ def main():
                               "commits_per_tick": round(c, 1),
                               **_abort_fields(summ)}
 
-    print(json.dumps({
+    doc = {
         "metric": "ycsb_nowait_zipf0.6_tput_faithful",
         "value": round(float(faithful), 1),
         "unit": "committed_txns_per_sec",
@@ -232,7 +336,11 @@ def main():
         "note": "value=acquire_window 1 (reference-faithful); greedy_tput="
                 "window 10; vs_baseline = faithful / (1M-cluster north star"
                 " / 8 chips); algs[*].commits_per_tick is chip-noise-immune",
-    }))
+    }
+    print(json.dumps(doc))
+    if history:
+        _append_history(doc, Config(cc_alg="NO_WAIT", acquire_window=1,
+                                    **YCSB_KW), out_dir)
 
 
 def _cli():
@@ -258,16 +366,27 @@ def _cli():
                    help="run ONLY this algorithm's headline YCSB cell "
                         "(faithful, acquire_window=1) and print the same "
                         "one-line JSON")
+    p.add_argument("--xmeter", action="store_true",
+                   help="compile & memory observatory smoke: recompile "
+                        "sentinel + ledger reconcile + roofline "
+                        "(exit 1/2 on sentinel/reconcile failure)")
+    p.add_argument("--no-history", action="store_true",
+                   help="skip the bench_history.jsonl trajectory append "
+                        "(headline runs only; obs runs never append)")
     p.add_argument("--out-dir", default="results",
-                   help="directory for trace JSON + run record")
+                   help="directory for trace JSON + run record + "
+                        "bench_history.jsonl")
     return p.parse_args()
 
 
 if __name__ == "__main__":
     _args = _cli()
+    if _args.xmeter:
+        raise SystemExit(run_xmeter(_args))
     if _args.trace or _args.profile or _args.prog_interval:
         raise SystemExit(run_obs(_args))
     if _args.alg:
-        run_single_alg(_args.alg)
+        run_single_alg(_args.alg, out_dir=_args.out_dir,
+                       history=not _args.no_history)
     else:
-        main()
+        main(out_dir=_args.out_dir, history=not _args.no_history)

@@ -315,6 +315,19 @@ class Config:
     #: the result from ``engine.profiler.snapshot()``.
     profile: bool = False
 
+    #: compile & memory observatory (deneva_tpu/obs/xmeter.py): per-entry
+    #: recompile sentinel (compile counts + trigger signatures; a steady
+    #: run must report ZERO post-warmup recompiles), HBM footprint ledger
+    #: (per-array carry/constant/temp accounting reconciled against the
+    #: compiled executable's memory_analysis()), and per-kernel roofline
+    #: from cost_analysis() FLOPs/bytes vs measured dispatch time.
+    #: Host-side only: zero extra device arrays, the tick graph is
+    #: untouched, and with the flag off the [summary] line is
+    #: byte-identical to a build without the observatory.  Adds
+    #: ``compile_cnt`` / ``compile_ms`` / ``hbm_bytes`` to [summary];
+    #: read the full picture from ``engine.xmeter.snapshot()``.
+    xmeter: bool = False
+
     # --- run protocol (reference config.h:349-350: 60s warmup + 60s run) ---
     seed: int = 12345
     query_pool_size: int = 1 << 16    # pre-generated queries (client_query.cpp:30)

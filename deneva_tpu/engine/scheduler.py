@@ -36,6 +36,7 @@ from deneva_tpu.config import Config
 from deneva_tpu.obs import trace as obs_trace
 from deneva_tpu.obs.prog import ProgressEmitter
 from deneva_tpu.obs.profiler import PhaseProfiler
+from deneva_tpu.obs.xmeter import XMeter, ledger_totals, state_ledger
 from deneva_tpu.engine.state import (
     NULL_KEY, STATUS_BACKOFF, STATUS_FREE, STATUS_RUNNING, STATUS_WAITING,
     TxnState,
@@ -877,7 +878,14 @@ class Engine:
         # host-side phase profiler (obs/profiler.py); None when disabled so
         # the steady-state dispatch path stays non-blocking
         self.profiler = PhaseProfiler() if cfg.profile else None
+        # compile & memory observatory (obs/xmeter.py); the wrap is
+        # transparent (_cache_size/lower pass through), so the profiler's
+        # dispatch attribution keeps working on the metered tick
+        self.xmeter = XMeter(cfg) if cfg.xmeter else None
+        if self.xmeter is not None:
+            self._tick_jit = self.xmeter.wrap("tick", self._tick_jit)
         self._compiled_scans: set[int] = set()  # n_ticks already compiled
+        self._flush_compiled = False            # expect_compile hint
 
     def init_state(self) -> EngineState:
         from deneva_tpu.config import MODE_NOCC, MODE_NORMAL
@@ -911,7 +919,15 @@ class Engine:
             else:
                 state = self._tick_jit(state)
             prog.maybe_emit(state, i + 1)
-        return self._flush_writes(state)
+        if self.xmeter is None:
+            return self._flush_writes(state)
+        # _flush_writes is a bound-method jit (self is a static arg), so
+        # it is windowed rather than wrapped; compiles once per engine
+        with self.xmeter.watch("flush_writes",
+                               expect_compile=not self._flush_compiled):
+            state = self._flush_writes(state)
+        self._flush_compiled = True
+        return state
 
     @functools.partial(jax.jit, static_argnums=(0, 1), donate_argnums=2)
     def _run_scan(self, n_ticks: int, state: EngineState) -> EngineState:
@@ -939,21 +955,33 @@ class Engine:
         """Fully device-side run: n_ticks in one lax.fori_loop under jit."""
         if state is None:
             state = self.init_state()
-        if self.profiler is None:
-            return self._run_scan(n_ticks, state)
         # _run_scan is a bound-method jit (cache introspection sees self's
         # descriptor, not the shared cache), so attribute compile time by
         # whether this n_ticks has been scanned on this engine before
         first = n_ticks not in self._compiled_scans
         self._compiled_scans.add(n_ticks)
-        phase = "trace_lower_compile" if first else "dispatch"
-        if first:
-            self.profiler.count("jit_recompiles")
-        with self.profiler.phase(phase):
-            out = self._run_scan(n_ticks, state)
-        with self.profiler.phase("execute"):
-            jax.block_until_ready(out)
-        return out
+        if self.profiler is None and self.xmeter is None:
+            return self._run_scan(n_ticks, state)
+
+        def dispatch():
+            if self.profiler is None:
+                return self._run_scan(n_ticks, state)
+            phase = "trace_lower_compile" if first else "dispatch"
+            if first:
+                self.profiler.count("jit_recompiles")
+            with self.profiler.phase(phase):
+                out = self._run_scan(n_ticks, state)
+            with self.profiler.phase("execute"):
+                jax.block_until_ready(out)
+            return out
+
+        if self.xmeter is None:
+            return dispatch()
+        # trip count is a static arg: a new n_ticks is a legitimate
+        # compile, recorded as its own trigger signature
+        with self.xmeter.watch("run_scan", sig=n_ticks,
+                               expect_compile=first):
+            return dispatch()
 
     def summary(self, state: EngineState, wall_seconds: float | None = None) -> dict:
         """Host-side stats in the reference's [summary] vocabulary
@@ -980,7 +1008,17 @@ class Engine:
         out["ccl_valid"] = n_valid
         if wall_seconds is not None:
             out["tput"] = s["txn_cnt"] / wall_seconds
+        if self.xmeter is not None:
+            # merged ONLY when the observatory is on: the default
+            # summary dict / [summary] line stay byte-identical
+            out.update(self.xmeter.summary_fields(
+                hbm_bytes=ledger_totals(self.ledger(state))["total"]))
         return out
+
+    def ledger(self, state: EngineState) -> list:
+        """Per-array HBM footprint rows (obs/xmeter.py state_ledger):
+        the donated carry plus the constant query-pool plane."""
+        return state_ledger(state, constants={"pool": self.pool_dev})
 
     def summary_line(self, state: EngineState,
                      wall_seconds: float | None = None,

@@ -20,6 +20,12 @@ Rules are deliberately syntactic with one-level local dataflow (names
 resolve to their last assignment): precise enough to prove the shipped
 idioms safe (argsort/arange indices, static config branches) without a
 type system.  What cannot be proven must be fixed or justify-suppressed.
+
+One rule inverts the region logic: COMPILE-IN-LOOP fires in HOST code —
+``For``/``While`` loops OUTSIDE every kernel span — on jit-wrapper
+constructions (``jax.jit(...)``, ``partial(jax.jit, ...)``, any call
+carrying ``static_argnums``/``static_argnames``) whose per-iteration
+rebuild discards the dispatch cache and recompiles every trip.
 """
 
 from __future__ import annotations
@@ -564,6 +570,55 @@ class KernelChecker(ast.NodeVisitor):
     visit_AsyncFunctionDef = visit_FunctionDef
 
 
+def _jit_ctor(fi: FileIndex, call: ast.Call) -> str | None:
+    """The jit-wrapper-construction spelling of this call, or None.
+    Covers direct ``jax.jit(...)``, ``functools.partial(jax.jit, ...)`` /
+    ``jax.tree_util.Partial(jax.jit, ...)``, and any call carrying a
+    ``static_argnums``/``static_argnames`` keyword (only jit-family
+    wrappers take those — each rebuild is a fresh dispatch cache)."""
+    name = _dotted(call.func)
+    r = fi.resolve_dotted(name) if name else None
+    if r in ("jax.jit", "jit"):
+        return "jax.jit(...)"
+    if r and (r == "partial" or r.endswith((".partial", "Partial"))) \
+            and call.args:
+        inner = _dotted(call.args[0])
+        ir = fi.resolve_dotted(inner) if inner else None
+        if ir in ("jax.jit", "jit"):
+            return f"{name}(jax.jit, ...)"
+    for k in call.keywords:
+        if k.arg in ("static_argnums", "static_argnames"):
+            return f"{name or '<call>'}({k.arg}=...)"
+    return None
+
+
+def _host_loop_findings(fi: FileIndex, index: KernelIndex) -> list[Finding]:
+    """COMPILE-IN-LOOP: jit-wrapper constructions inside host-side
+    Python loops (loops within kernel regions are traced, not host
+    iteration — the per-region rules own those)."""
+    spans = [(n.lineno, getattr(n, "end_lineno", n.lineno))
+             for n in index.kernel_roots(fi)]
+    out: list[Finding] = []
+    for node in ast.walk(fi.tree):
+        if not isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+            continue
+        if any(lo <= node.lineno <= hi for lo, hi in spans):
+            continue
+        for c in ast.walk(node):
+            if not isinstance(c, ast.Call):
+                continue
+            ctor = _jit_ctor(fi, c)
+            if ctor:
+                out.append(Finding(
+                    rule="COMPILE-IN-LOOP", path=fi.path, line=c.lineno,
+                    end_line=getattr(c, "end_lineno", c.lineno),
+                    message=f"{ctor} constructed inside a host loop: a "
+                            "fresh callable (empty dispatch cache) every "
+                            "iteration — retrace + recompile per trip; "
+                            "hoist it above the loop"))
+    return out
+
+
 def check_file(fi: FileIndex, index: KernelIndex) -> list[Finding]:
     out: list[Finding] = []
     for root in index.kernel_roots(fi):
@@ -572,4 +627,5 @@ def check_file(fi: FileIndex, index: KernelIndex) -> list[Finding]:
         for stmt in body:
             chk.visit(stmt)
         out.extend(chk.findings)
+    out.extend(_host_loop_findings(fi, index))
     return out

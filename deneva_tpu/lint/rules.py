@@ -117,6 +117,22 @@ _ALL = [
             "the view does not cover).",
     ),
     Rule(
+        id="COMPILE-IN-LOOP",
+        title="jit-wrapper construction inside a host loop",
+        rationale="jax.jit / functools.partial(jax.jit, ...) built inside "
+                  "a Python loop yields a FRESH callable each iteration "
+                  "with an empty dispatch cache: every iteration retraces "
+                  "and recompiles.  Same hazard for static_argnums/"
+                  "static_argnames wrappers rebuilt per iteration — a "
+                  "Python-varying static arg is a new cache key every "
+                  "time.  This is the recompile sentinel's static cousin: "
+                  "obs/xmeter.py catches it at runtime, this rule at "
+                  "review time.",
+        fix="Hoist the jit construction above the loop (or cache it on "
+            "the instance, as Engine.__init__ does for _tick_jit) and "
+            "dispatch the SAME wrapped callable each iteration.",
+    ),
+    Rule(
         id="SUPPRESS-NO-REASON",
         title="Suppression without a justification",
         rationale="`# lint: disable=RULE` must record WHY the finding is "

@@ -13,7 +13,20 @@ zero device work:
 - :mod:`deneva_tpu.obs.profiler`  host-side phase timers around
                                   trace/lower/compile vs execute
                                   (``Config.profile``) plus structured
-                                  JSON run records under ``results/``.
+                                  JSON run records under ``results/``;
+- :mod:`deneva_tpu.obs.xmeter`    compile & memory observatory
+                                  (``Config.xmeter``): recompile
+                                  sentinel, HBM footprint ledger and
+                                  per-kernel roofline from the compiled
+                                  executables' cost/memory analyses;
+- :mod:`deneva_tpu.obs.regress`   bench regression gate — compares the
+                                  current BENCH snapshot against the
+                                  trajectory median
+                                  (``python -m deneva_tpu.obs.regress``).
+
+xmeter and regress are deliberately NOT imported here: both double as
+``python -m`` CLIs (like obs.report), and importing a ``-m`` target from
+its package ``__init__`` trips runpy's found-in-sys.modules warning.
 """
 
 from deneva_tpu.obs import prog, profiler, trace  # noqa: F401

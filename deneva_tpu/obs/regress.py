@@ -1,0 +1,232 @@
+"""Bench regression gate: compare the current headline cell against the
+repo's own measured trajectory.
+
+``python -m deneva_tpu.obs.regress BENCH_r*.json results/`` loads every
+trajectory point it is given — the committed ``BENCH_r*.json`` snapshots
+(one per PR round; failed rounds with ``rc != 0`` or a null ``parsed``
+payload are skipped) plus any ``bench_history.jsonl`` appended by
+bench.py runs — and gates two families:
+
+- **headline** — the wall-clock ``value`` of the current point vs the
+  median of prior points carrying the SAME metric name.  Wall tput on
+  the tunneled chip drifts +-10-30% session to session (PROFILE.md), so
+  the default tolerance is generous (``--tolerance 0.5``); the gate
+  catches collapses, not noise.
+- **per-alg commits_per_tick** — the chip-noise-immune metric (committed
+  txns per scheduler tick is a pure function of the schedule, not the
+  clock).  Default ``--cpt-tolerance 0.15``: a 20% drop in any
+  algorithm's cell fails the gate.
+
+A gate with no prior data (e.g. per-alg cells first appeared in round 5)
+is SKIPPED with a note, not failed — the gate self-arms as history
+accumulates.  Exit code = number of regressions (0 == clean), wired
+into scripts/check.sh after the bench smoke.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+import numpy as np
+
+DEFAULT_HEADLINE_TOL = 0.5
+DEFAULT_CPT_TOL = 0.15
+
+HISTORY_BASENAME = "bench_history.jsonl"
+
+
+# ---------------------------------------------------------------------------
+# trajectory loading
+# ---------------------------------------------------------------------------
+
+def _cpt(cell) -> Optional[float]:
+    """commits_per_tick from a per-alg cell (dict cell or bare number)."""
+    if isinstance(cell, dict):
+        v = cell.get("commits_per_tick")
+    else:
+        v = cell
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return None
+
+
+def _entry(source: str, order: tuple, doc: dict) -> Optional[dict]:
+    """Normalize one trajectory point; None when it carries no metric."""
+    metric = doc.get("metric")
+    try:
+        value = float(doc.get("value"))
+    except (TypeError, ValueError):
+        return None
+    algs = {}
+    for alg, cell in (doc.get("algs") or {}).items():
+        c = _cpt(cell)
+        if c is not None:
+            algs[alg] = c
+    return {"source": source, "order": order, "metric": metric,
+            "value": value, "algs": algs}
+
+
+def load_snapshot(path: str) -> Optional[dict]:
+    """A committed BENCH_r*.json: {"n", "rc", "parsed"} — failed rounds
+    (rc != 0 / parsed null, e.g. the round-2 mid-history crash) are
+    part of the record but not of the trajectory."""
+    with open(path) as f:
+        doc = json.load(f)
+    if doc.get("rc", 0) != 0 or not doc.get("parsed"):
+        return None
+    return _entry(path, (0, float(doc.get("n", 0))), doc["parsed"])
+
+
+def load_history(path: str) -> list[dict]:
+    """bench.py's append-only results/bench_history.jsonl (one JSON
+    object per line: unix_time, commit, config_hash, metric, value,
+    algs).  Malformed lines are skipped — the file is append-only across
+    crashes."""
+    out = []
+    if not os.path.exists(path):
+        return out
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                doc = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            e = _entry(path, (1, float(doc.get("unix_time", 0))), doc)
+            if e is not None:
+                out.append(e)
+    return out
+
+
+def load_trajectory(paths: list[str]) -> list[dict]:
+    """Snapshots + history, chronological (snapshots by round number
+    first — they predate the history file — then history by time)."""
+    entries = []
+    for p in paths:
+        if os.path.isdir(p):
+            e = load_history(os.path.join(p, HISTORY_BASENAME))
+            entries.extend(e)
+        elif p.endswith(".jsonl"):
+            entries.extend(load_history(p))
+        else:
+            e = load_snapshot(p)
+            if e is not None:
+                entries.append(e)
+    entries.sort(key=lambda e: e["order"])
+    return entries
+
+
+# ---------------------------------------------------------------------------
+# the gate
+# ---------------------------------------------------------------------------
+
+def gate(entries: list[dict], current: Optional[dict] = None,
+         tolerance: float = DEFAULT_HEADLINE_TOL,
+         cpt_tolerance: float = DEFAULT_CPT_TOL) -> dict:
+    """Compare ``current`` (default: the latest entry) against the
+    median of the prior trajectory.  Returns {"current", "checks",
+    "failures", "skipped"}; a check fails when the current value drops
+    below (1 - tolerance) x median(prior)."""
+    if current is None:
+        if not entries:
+            return {"current": None, "checks": [], "failures": [],
+                    "skipped": ["empty trajectory: nothing to gate"]}
+        current = entries[-1]
+    prior = [e for e in entries if e is not current]
+    checks, failures, skipped = [], [], []
+
+    def check(name: str, cur: float, baseline: list[float], tol: float):
+        if not baseline:
+            skipped.append(f"{name}: no prior data "
+                           f"(current={cur:g}; gate arms next round)")
+            return
+        med = float(np.median(baseline))
+        floor = (1.0 - tol) * med
+        ok = cur >= floor
+        checks.append({"name": name, "current": cur, "median": med,
+                       "floor": floor, "n_prior": len(baseline),
+                       "ok": ok})
+        if not ok:
+            failures.append(f"{name}: {cur:g} < floor {floor:g} "
+                            f"(median {med:g} over {len(baseline)} "
+                            f"prior, tol {tol:g})")
+
+    check(f"headline[{current['metric']}]", current["value"],
+          [e["value"] for e in prior if e["metric"] == current["metric"]],
+          tolerance)
+    for alg, cur in sorted(current["algs"].items()):
+        check(f"commits_per_tick[{alg}]", cur,
+              [e["algs"][alg] for e in prior if alg in e["algs"]],
+              cpt_tolerance)
+    return {"current": current, "checks": checks, "failures": failures,
+            "skipped": skipped}
+
+
+def render_text(result: dict) -> str:
+    lines = []
+    cur = result["current"]
+    if cur is not None:
+        lines.append(f"[regress] current: {cur['source']} "
+                     f"({cur['metric']}={cur['value']:g}, "
+                     f"{len(cur['algs'])} per-alg cells)")
+    for c in result["checks"]:
+        lines.append(f"  {'OK  ' if c['ok'] else 'FAIL'} {c['name']}: "
+                     f"{c['current']:g} vs median {c['median']:g} "
+                     f"(floor {c['floor']:g}, n={c['n_prior']})")
+    for s in result["skipped"]:
+        lines.append(f"  skip {s}")
+    n = len(result["failures"])
+    lines.append(f"[regress] {n} regression(s)")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    import argparse
+    p = argparse.ArgumentParser(
+        prog="python -m deneva_tpu.obs.regress",
+        description="gate the current bench point against the "
+                    "trajectory median; exit code = regressions")
+    p.add_argument("paths", nargs="+",
+                   help="BENCH_r*.json snapshots, bench_history.jsonl "
+                        "files, or directories containing one")
+    p.add_argument("--tolerance", type=float,
+                   default=DEFAULT_HEADLINE_TOL,
+                   help="allowed fractional drop of the wall-clock "
+                        "headline vs the median (default %(default)s: "
+                        "wall tput drifts with the session)")
+    p.add_argument("--cpt-tolerance", type=float,
+                   default=DEFAULT_CPT_TOL,
+                   help="allowed fractional drop of per-alg "
+                        "commits_per_tick (default %(default)s)")
+    p.add_argument("--current", default=None,
+                   help="gate THIS snapshot path instead of the latest "
+                        "trajectory point")
+    p.add_argument("--json", action="store_true")
+    args = p.parse_args(argv)
+
+    entries = load_trajectory(args.paths)
+    current = None
+    if args.current:
+        current = load_snapshot(args.current)
+        if current is None:
+            print(f"[regress] --current {args.current} has no parsed "
+                  "metric (failed run?)")
+            return 1
+        entries = [e for e in entries
+                   if e["source"] != current["source"]] + [current]
+    result = gate(entries, current=current, tolerance=args.tolerance,
+                  cpt_tolerance=args.cpt_tolerance)
+    if args.json:
+        print(json.dumps(result))
+    else:
+        print(render_text(result))
+    return min(len(result["failures"]), 125)
+
+
+if __name__ == "__main__":          # pragma: no cover - CLI shim
+    raise SystemExit(main())

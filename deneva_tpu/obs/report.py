@@ -145,11 +145,15 @@ def hot_keys(stats: dict, topk: int = 8) -> list:
 
 
 def build_report(summary: dict, timeline: dict | None = None,
-                 stats: dict | None = None, topk: int = 8) -> dict:
+                 stats: dict | None = None, topk: int = 8,
+                 xmeter: dict | None = None) -> dict:
     """The machine-readable waterfall: phases (slot-ticks + share),
     throughput, the abort taxonomy, hot keys / per-partition conflicts /
     wait-depth histogram (when the run kept a heatmap), reconciliation
-    failures and watchdog findings."""
+    failures and watchdog findings.  ``xmeter`` (an
+    obs/xmeter.py XMeter.snapshot()) adds the compile/roofline section:
+    per-entry compile counts, post-warmup violations, and the
+    achieved-vs-peak roofline rows."""
     phases = {}
     total = 0
     for phase, key, _ in _PHASES:
@@ -181,6 +185,14 @@ def build_report(summary: dict, timeline: dict | None = None,
             rep["wait_depth_hist"] = wd.reshape(-1, wd.shape[-1]) \
                                        .sum(axis=0).tolist() \
                 if wd.ndim > 1 else wd.tolist()
+    if xmeter is not None:
+        rep["xmeter"] = {
+            "compile_cnt": int(xmeter.get("compile_cnt", 0)),
+            "compile_ms": float(xmeter.get("compile_ms", 0.0)),
+            "steady_violations": list(xmeter.get("steady_violations",
+                                                 [])),
+            "roofline": list(xmeter.get("roofline", [])),
+        }
     rep["reconcile_failures"] = reconcile(summary, timeline)
     findings, code = watchdog(summary, timeline,
                               precomputed_reconcile=rep["reconcile_failures"])
@@ -283,6 +295,25 @@ def render_text(rep: dict) -> str:
         lines.append("[waitdepth] wait-streak length histogram "
                      f"(ticks waited; last bin = >={len(wd) - 1}): "
                      + " ".join(str(v) for v in wd))
+    if rep.get("xmeter") is not None:
+        xr = rep["xmeter"]
+        lines.append(f"[compile] {xr['compile_cnt']} compiles, "
+                     f"{xr['compile_ms']:.1f} ms"
+                     + ("" if not xr["steady_violations"] else
+                        f"; {len(xr['steady_violations'])} POST-WARMUP "
+                        "recompile(s):"))
+        for v in xr["steady_violations"]:
+            lines.append(f"  RECOMPILE {v.get('entry')}: "
+                         f"{v.get('signature')}")
+        if xr["roofline"]:
+            lines.append("[roofline] achieved vs peak per entry point")
+            for r in xr["roofline"]:
+                lines.append(
+                    f"  {r['entry']:<14} {r['mean_ms']:>8.3f} ms  "
+                    f"{r['achieved_gflops']:>8.2f} GFLOP/s "
+                    f"({r['peak_flop_frac']:6.2%})  "
+                    f"{r['achieved_gbps']:>8.2f} GB/s "
+                    f"({r['peak_bw_frac']:6.2%})  {r['bound']}-bound")
     for flag, msg in rep["watchdog"]["findings"]:
         lines.append(f"[watchdog] {flag}: {msg}")
     if not rep["watchdog"]["findings"]:
@@ -293,7 +324,8 @@ def render_text(rep: dict) -> str:
 def report_from_record(rec: dict) -> dict:
     """Build the report from a run-record JSON document
     (obs/profiler.py write_run_record)."""
-    return build_report(rec["summary"], rec.get("timeline"))
+    return build_report(rec["summary"], rec.get("timeline"),
+                        xmeter=rec.get("xmeter"))
 
 
 def main(argv=None) -> int:

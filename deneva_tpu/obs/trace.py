@@ -178,14 +178,18 @@ def totals(state_or_stats) -> dict:
 
 
 def to_chrome_trace(state_or_stats, path: str, n_ticks: int | None = None,
-                    tick_us: float = 1.0) -> str:
+                    tick_us: float = 1.0,
+                    xmeter: dict | None = None) -> str:
     """Export the timeline as Chrome trace-event JSON (the JSON Array
     Format with counter events, loadable at ui.perfetto.dev).
 
     One process per shard; two counter tracks per shard (txn flow and
     slot occupancy).  ``tick_us`` maps one scheduler tick onto the trace
     timebase (pass the measured mean tick microseconds for wall-true
-    plots; the default keeps tick units)."""
+    plots; the default keeps tick units).  ``xmeter`` (an obs/xmeter.py
+    ``XMeter.snapshot()``) adds a 5th counter track, "kernel ms": the
+    metered per-call blocked durations of every jitted entry point,
+    indexed by call number on the same timebase."""
     a = _buffer(state_or_stats)
     shards = a[None] if a.ndim == 2 else a          # (N, T, K)
     rbuf = _reason_buffer(state_or_stats)
@@ -224,12 +228,29 @@ def to_chrome_trace(state_or_stats, path: str, n_ticks: int | None = None,
                                "ts": ts, "pid": node,
                                "args": {c: int(rshards[node][t, i])
                                         for i, c in enumerate(rnames)}})
+    xentries = []
+    if xmeter:
+        # 5th counter track, present only when an xmeter snapshot is
+        # passed (same compatibility discipline as the 4th): one "kernel
+        # ms" counter per entry point, its per-call blocked dispatch
+        # durations indexed by call number on the shared timebase.
+        for name, ent in sorted(xmeter.get("entries", {}).items()):
+            durs = ent.get("durations_ms") or []
+            if not durs:
+                continue
+            xentries.append(name)
+            for i, ms in enumerate(durs):
+                events.append({"name": "kernel ms", "ph": "C",
+                               "ts": float(i) * tick_us, "pid": 0,
+                               "args": {name: float(ms)}})
     doc = {"traceEvents": events, "displayTimeUnit": "ms",
            "metadata": {"tool": "deneva_tpu.obs.trace",
                         "columns": list(TRACE_COLUMNS),
                         "tick_us": tick_us, "shards": N, "ticks": T}}
     if rshards is not None:
         doc["metadata"]["reason_columns"] = list(rnames)
+    if xentries:
+        doc["metadata"]["xmeter_entries"] = xentries
     with open(path, "w") as f:
         json.dump(doc, f)
     return path

@@ -1,0 +1,625 @@
+"""Compile & memory observatory (``Config.xmeter``): recompile sentinel,
+HBM footprint ledger, and per-kernel roofline.
+
+PRs 1 and 4 instrumented the transaction plane (tick trace ring, abort
+taxonomy); this module instruments the layer BELOW it — the XLA
+compile/dispatch/memory plane, where the silent performance bugs live:
+
+- **recompile sentinel** — every jitted entry point the engine dispatches
+  is wrapped (:meth:`XMeter.wrap`) or windowed (:meth:`XMeter.watch`) so
+  compilations are counted PER ENTRY POINT with their trigger signature
+  (arg shapes/dtypes + treedef).  Two detectors corroborate: the jit
+  dispatch cache growing across a call (``fn._cache_size()``, the same
+  probe obs/profiler.py uses) and jax's own compile-event stream
+  (``jax.monitoring`` ``backend_compile`` duration events), with an
+  explicit ``expect_compile`` hint as the fallback where neither exists.
+  After :meth:`XMeter.mark_warm`, a steady-state run must report zero
+  further compiles; :meth:`XMeter.steady_violations` names the offending
+  entry point and the signature that triggered it.
+
+- **HBM footprint ledger** — :func:`state_ledger` walks the engine's
+  donated/carried state pytree (engine/state.py TxnState + db/tables/
+  stats rings) plus the constant plane (the device query pool) into a
+  per-array ledger (name, shape, dtype, nbytes, carry/constant/temp);
+  :meth:`XMeter.analyze` AOT-compiles an entry point from its captured
+  abstract signature and reconciles the ledger against the executable's
+  ``memory_analysis()`` live-buffer accounting (donated carry ==
+  ``argument_size_in_bytes`` exactly on every backend tested; the gate
+  allows 1%).  :func:`budget_check` turns the same ledger into the
+  ROADMAP's sizing tool: flag when the (txn x access) tensor plane would
+  spill a ``--budget-mb`` HBM budget at a target B/R/NODE_CNT
+  (CLI: ``python -m deneva_tpu.obs.xmeter --budget-mb ...``).
+
+- **per-kernel roofline** — ``cost_analysis()`` FLOPs / bytes-accessed
+  paired with measured blocked dispatch time into achieved-vs-peak
+  fractions (:meth:`XMeter.roofline`), rendered by obs/report.py and as
+  a 5th Perfetto counter track (obs/trace.py); PROFILE.md's primitive
+  cost table is generated from this instead of maintained by hand.
+
+Everything here is host-side: no extra device arrays, no change to any
+tick graph.  The observation cost is the AOT lower+compile that
+:meth:`analyze` performs once per analyzed entry point (it does NOT
+populate the dispatch cache, so it never shadows a real compile) and,
+when ``block=True``, a ``block_until_ready`` per metered call so
+roofline times are real device times.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from contextlib import contextmanager
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+SNAPSHOT_SCHEMA = "deneva-tpu/xmeter/v1"
+
+#: array-plane classification in the ledger
+KIND_CARRY = "carry"        # donated engine state, threaded tick to tick
+KIND_CONSTANT = "constant"  # device-resident read-only plane (query pool)
+KIND_TEMP = "temp"          # executable scratch (memory_analysis temp)
+
+#: nominal peak envelopes for the roofline denominator, per backend.
+#: "tpu" is the BASELINE.md north-star part (v5e: 197 TFLOP/s bf16,
+#: 819 GB/s HBM); "cpu" is a nominal laptop-class envelope so smoke runs
+#: produce finite fractions — CPU fractions are indicative only.
+PEAKS = {
+    "tpu": {"flops_per_s": 197e12, "bytes_per_s": 819e9},
+    "cpu": {"flops_per_s": 5e10, "bytes_per_s": 2e10},
+}
+
+#: per-entry call-duration ring depth (host list; oldest dropped)
+_DURATION_RING = 4096
+
+
+# ---------------------------------------------------------------------------
+# backend-compile event stream (jax.monitoring)
+# ---------------------------------------------------------------------------
+
+#: module-level singleton: jax.monitoring only exposes
+#: ``clear_event_listeners`` (no per-listener unregister), so the
+#: listener installs once per process and every XMeter reads deltas.
+_BACKEND = {"installed": False, "available": False,
+            "count": 0, "seconds": 0.0}
+
+
+def _on_event_duration(event: str, duration: float, **_kw) -> None:
+    if "backend_compile" in event:
+        _BACKEND["count"] += 1
+        _BACKEND["seconds"] += float(duration)
+
+
+def install_backend_listener() -> bool:
+    """Idempotently hook jax's compile-duration event stream; returns
+    whether the stream is available on this jax version."""
+    if _BACKEND["installed"]:
+        return _BACKEND["available"]
+    _BACKEND["installed"] = True
+    try:
+        from jax import monitoring
+        monitoring.register_event_duration_secs_listener(_on_event_duration)
+        _BACKEND["available"] = True
+    except Exception:       # pragma: no cover - jax without monitoring
+        _BACKEND["available"] = False
+    return _BACKEND["available"]
+
+
+def backend_compile_totals() -> tuple[int, float]:
+    """(count, seconds) of backend compiles observed process-wide."""
+    return _BACKEND["count"], _BACKEND["seconds"]
+
+
+# ---------------------------------------------------------------------------
+# call signatures
+# ---------------------------------------------------------------------------
+
+def call_signature(args: tuple, kwargs: dict | None = None) -> tuple:
+    """Hashable trigger signature of a call: the pytree structure plus
+    each array leaf's (shape, dtype, weak_type) — exactly the cache key
+    components whose change forces a retrace — with non-array leaves
+    recorded by repr (static values baked into the trace)."""
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs or {}))
+    sig = []
+    for x in leaves:
+        if hasattr(x, "shape") and hasattr(x, "dtype"):
+            sig.append((tuple(x.shape), str(x.dtype),
+                        bool(getattr(x, "weak_type", False))))
+        else:
+            sig.append(("static", repr(x)))
+    return (str(treedef), tuple(sig))
+
+
+def abstract_args(args: tuple) -> tuple:
+    """ShapeDtypeStruct skeleton of a call's arguments, captured BEFORE
+    dispatch (donation invalidates the concrete buffers after)."""
+    return jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)
+        if hasattr(x, "shape") and hasattr(x, "dtype") else x, args)
+
+
+# ---------------------------------------------------------------------------
+# per-entry-point meter
+# ---------------------------------------------------------------------------
+
+class EntryMeter:
+    """Compile/dispatch accounting for ONE jitted entry point."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.compile_cnt = 0          # calls/windows that compiled
+        self.compile_ms = 0.0
+        self.calls = 0
+        self.call_ms = 0.0
+        self.sigs: dict[tuple, int] = {}
+        self.warm_at: Optional[int] = None   # compile_cnt at mark_warm
+        self.post_warm: list[dict] = []      # violations after mark_warm
+        self.durations_ms: list[float] = []  # per-call (blocked) wall ms
+        self.abstract: Optional[tuple] = None
+        self.fn: Any = None                  # jitted callable for analyze()
+        self.analysis: Optional[dict] = None
+
+    def note(self, compiled: bool, dt_ms: float, compile_ms: float,
+             sig: Optional[tuple], blocked: bool) -> None:
+        self.calls += 1
+        self.call_ms += dt_ms
+        if sig is not None:
+            self.sigs[sig] = self.sigs.get(sig, 0) + 1
+        if blocked:
+            self.durations_ms.append(dt_ms)
+            if len(self.durations_ms) > _DURATION_RING:
+                del self.durations_ms[0]
+        if compiled:
+            self.compile_cnt += 1
+            self.compile_ms += compile_ms
+            if self.warm_at is not None and self.compile_cnt > self.warm_at:
+                self.post_warm.append({
+                    "entry": self.name,
+                    "compile_ms": round(compile_ms, 3),
+                    "signature": repr(sig) if sig is not None else None,
+                })
+
+    def mean_ms(self) -> Optional[float]:
+        if self.durations_ms:
+            return float(np.mean(self.durations_ms))
+        return None
+
+    def snapshot(self) -> dict:
+        return {
+            "compile_cnt": self.compile_cnt,
+            "compile_ms": round(self.compile_ms, 3),
+            "calls": self.calls,
+            "call_ms": round(self.call_ms, 3),
+            "mean_ms": self.mean_ms(),
+            "distinct_signatures": len(self.sigs),
+            "post_warm": list(self.post_warm),
+            "analysis": self.analysis,
+            "durations_ms": [round(d, 4) for d in self.durations_ms],
+        }
+
+
+class MeteredFn:
+    """Transparent wrapper over a jitted callable: every ``__call__``
+    flows through :meth:`XMeter.record_call`.  Exposes ``_cache_size``
+    and ``lower`` so obs/profiler.py's dispatch attribution and the AOT
+    analysis path keep working on the wrapped function."""
+
+    def __init__(self, xm: "XMeter", entry: EntryMeter, fn):
+        self._xm = xm
+        self._entry = entry
+        self._fn = fn
+
+    def _cache_size(self) -> Optional[int]:
+        try:
+            return self._fn._cache_size()
+        except Exception:
+            return None
+
+    def lower(self, *args, **kwargs):
+        return self._fn.lower(*args, **kwargs)
+
+    def __call__(self, *args, **kwargs):
+        return self._xm.record_call(self._entry, self._fn, args, kwargs)
+
+
+class XMeter:
+    """The observatory: entry-point meters + ledger/roofline assembly.
+
+    ``block``: when True every metered call blocks until ready before
+    the clock stops, so per-call durations are real device times (the
+    roofline numerator).  Off by default — blocking forfeits host/device
+    pipelining, same trade as ``Config.profile``.
+    """
+
+    def __init__(self, cfg=None, block: bool = False):
+        self.cfg = cfg
+        self.block = block
+        self.entries: dict[str, EntryMeter] = {}
+        self.warm = False
+        install_backend_listener()
+
+    # -- metering ------------------------------------------------------
+    def entry(self, name: str) -> EntryMeter:
+        e = self.entries.get(name)
+        if e is None:
+            e = self.entries[name] = EntryMeter(name)
+        return e
+
+    def wrap(self, name: str, fn) -> MeteredFn:
+        """Wrap a jitted callable for per-call metering."""
+        e = self.entry(name)
+        e.fn = fn
+        return MeteredFn(self, e, fn)
+
+    def record_call(self, entry: EntryMeter, fn, args: tuple,
+                    kwargs: dict):
+        sig = call_signature(args, kwargs)
+        if entry.abstract is None and not kwargs:
+            entry.abstract = abstract_args(args)
+        try:
+            before = fn._cache_size()
+        except Exception:
+            before = None
+        bc0, bs0 = backend_compile_totals()
+        t0 = time.perf_counter()
+        out = fn(*args, **kwargs)
+        if self.block:
+            jax.block_until_ready(out)
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        try:
+            after = fn._cache_size()
+        except Exception:
+            after = None
+        bc1, bs1 = backend_compile_totals()
+        compiled = (before is not None and after is not None
+                    and after > before) or bc1 > bc0
+        compile_ms = (bs1 - bs0) * 1e3 if bc1 > bc0 else (
+            dt_ms if compiled else 0.0)
+        entry.note(compiled, dt_ms, compile_ms, sig, self.block)
+        return out
+
+    @contextmanager
+    def watch(self, name: str, sig: Any = None,
+              expect_compile: Optional[bool] = None):
+        """Meter a compile/dispatch window that is not a single wrapped
+        call (bound-method jits, the sharded fresh-jit scan).  Compile
+        detection rides the backend event stream; ``expect_compile`` is
+        the caller's static knowledge, used when the stream is
+        unavailable."""
+        e = self.entry(name)
+        bc0, bs0 = backend_compile_totals()
+        t0 = time.perf_counter()
+        yield e
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        bc1, bs1 = backend_compile_totals()
+        if _BACKEND["available"]:
+            compiled = bc1 > bc0
+        else:                     # pragma: no cover - jax w/o monitoring
+            compiled = bool(expect_compile)
+        compile_ms = (bs1 - bs0) * 1e3 if bc1 > bc0 else (
+            dt_ms if compiled else 0.0)
+        wsig = None if sig is None else ("watch", repr(sig))
+        e.note(compiled, dt_ms, compile_ms, wsig, blocked=False)
+
+    # -- steady-state sentinel ----------------------------------------
+    def mark_warm(self) -> None:
+        """Declare warmup over: any compile after this is a violation."""
+        self.warm = True
+        for e in self.entries.values():
+            e.warm_at = e.compile_cnt
+
+    def steady_violations(self) -> list[dict]:
+        """Post-warmup recompiles, naming the offending entry point and
+        the signature that triggered each (empty == steady state held)."""
+        out = []
+        for e in self.entries.values():
+            out.extend(e.post_warm)
+        return out
+
+    # -- totals / summary ---------------------------------------------
+    def compile_totals(self) -> tuple[int, float]:
+        cnt = sum(e.compile_cnt for e in self.entries.values())
+        ms = sum(e.compile_ms for e in self.entries.values())
+        return cnt, ms
+
+    def summary_fields(self, hbm_bytes: Optional[int] = None) -> dict:
+        """The [summary] keys (merged by Engine.summary only when the
+        observatory is on, so the off path stays byte-identical)."""
+        cnt, ms = self.compile_totals()
+        out = {"compile_cnt": cnt, "compile_ms": round(ms, 3)}
+        if hbm_bytes is not None:
+            out["hbm_bytes"] = int(hbm_bytes)
+        return out
+
+    # -- AOT cost/memory analysis -------------------------------------
+    def analyze(self, name: str) -> dict:
+        """AOT lower+compile the entry point from its captured abstract
+        signature; attach cost_analysis/memory_analysis numbers.  One
+        extra compile per call (it does not touch the dispatch cache —
+        steady-state detection is unaffected)."""
+        e = self.entries[name]
+        assert e.fn is not None and e.abstract is not None, \
+            f"entry '{name}' was never called through a wrap()ed fn"
+        compiled = e.fn.lower(*e.abstract).compile()
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        mem = compiled.memory_analysis()
+        e.analysis = {
+            "flops": float(cost.get("flops", 0.0)),
+            "bytes_accessed": float(cost.get("bytes accessed", 0.0)),
+            "argument_bytes": int(getattr(mem, "argument_size_in_bytes",
+                                          0)),
+            "output_bytes": int(getattr(mem, "output_size_in_bytes", 0)),
+            "temp_bytes": int(getattr(mem, "temp_size_in_bytes", 0)),
+            "alias_bytes": int(getattr(mem, "alias_size_in_bytes", 0)),
+            "code_bytes": int(getattr(mem, "generated_code_size_in_bytes",
+                                      0)),
+        }
+        return e.analysis
+
+    # -- roofline -------------------------------------------------------
+    def roofline(self, peaks: Optional[dict] = None,
+                 backend: Optional[str] = None) -> list[dict]:
+        """Achieved-vs-peak rows for every analyzed entry with measured
+        (blocked) durations.  ``bound`` names the roofline side whose
+        peak-time requirement is larger — the resource the kernel would
+        saturate first."""
+        if peaks is None:
+            backend = backend or jax.default_backend()
+            peaks = PEAKS.get(backend, PEAKS["cpu"])
+        pf, pb = peaks["flops_per_s"], peaks["bytes_per_s"]
+        rows = []
+        for name in sorted(self.entries):
+            e = self.entries[name]
+            mean_ms = e.mean_ms()
+            if e.analysis is None or mean_ms is None or mean_ms <= 0:
+                continue
+            t = mean_ms / 1e3
+            fl, by = e.analysis["flops"], e.analysis["bytes_accessed"]
+            rows.append({
+                "entry": name,
+                "calls": e.calls,
+                "mean_ms": round(mean_ms, 4),
+                "flops": fl,
+                "bytes_accessed": by,
+                "achieved_gflops": round(fl / t / 1e9, 3),
+                "achieved_gbps": round(by / t / 1e9, 3),
+                "peak_flop_frac": round(fl / t / pf, 6),
+                "peak_bw_frac": round(by / t / pb, 6),
+                "bound": "memory" if by / pb >= fl / pf else "compute",
+            })
+        return rows
+
+    # -- export ---------------------------------------------------------
+    def snapshot(self) -> dict:
+        cnt, ms = self.compile_totals()
+        bc, bs = backend_compile_totals()
+        return {
+            "schema": SNAPSHOT_SCHEMA,
+            "backend": jax.default_backend(),
+            "compile_cnt": cnt,
+            "compile_ms": round(ms, 3),
+            "warm": self.warm,
+            "steady_violations": self.steady_violations(),
+            "entries": {k: e.snapshot()
+                        for k, e in sorted(self.entries.items())},
+            "backend_compile_events": {"count": bc,
+                                       "seconds": round(bs, 3)},
+            "roofline": self.roofline(),
+        }
+
+
+# ---------------------------------------------------------------------------
+# HBM footprint ledger
+# ---------------------------------------------------------------------------
+
+def _named_leaves(prefix: str, obj):
+    """Depth-first (name, array) walk of the engine state pytree:
+    NamedTuples by field, dicts by sorted key, sequences by index."""
+    if hasattr(obj, "_asdict"):                      # NamedTuple
+        for k, v in obj._asdict().items():
+            yield from _named_leaves(f"{prefix}.{k}" if prefix else k, v)
+    elif isinstance(obj, dict):
+        for k in sorted(obj):
+            yield from _named_leaves(f"{prefix}.{k}" if prefix else str(k),
+                                     obj[k])
+    elif isinstance(obj, (list, tuple)):
+        for i, v in enumerate(obj):
+            yield from _named_leaves(f"{prefix}[{i}]", v)
+    elif hasattr(obj, "shape") and hasattr(obj, "dtype"):
+        yield prefix, obj
+
+
+def _nbytes(x) -> int:
+    return int(np.prod(x.shape)) * np.dtype(x.dtype).itemsize \
+        if x.shape else np.dtype(x.dtype).itemsize
+
+
+def state_ledger(state, constants: Optional[dict] = None,
+                 temp_bytes: int = 0) -> list[dict]:
+    """Per-array HBM ledger of an engine's resident footprint.
+
+    ``state``: the donated carry (EngineState / ShardState) — every leaf
+    is a ``carry`` row.  ``constants``: named read-only device planes
+    (e.g. ``{"pool": engine.pool_dev}``) — ``constant`` rows.
+    ``temp_bytes``: executable scratch from ``memory_analysis()``
+    (:meth:`XMeter.analyze`) — one synthetic ``temp`` row.
+    """
+    rows = []
+    for name, arr in _named_leaves("", state):
+        rows.append({"name": name, "shape": tuple(arr.shape),
+                     "dtype": str(arr.dtype), "nbytes": _nbytes(arr),
+                     "kind": KIND_CARRY})
+    for group, obj in sorted((constants or {}).items()):
+        for name, arr in _named_leaves(group, obj):
+            rows.append({"name": name, "shape": tuple(arr.shape),
+                         "dtype": str(arr.dtype), "nbytes": _nbytes(arr),
+                         "kind": KIND_CONSTANT})
+    if temp_bytes > 0:
+        rows.append({"name": "<xla temp>", "shape": (), "dtype": "opaque",
+                     "nbytes": int(temp_bytes), "kind": KIND_TEMP})
+    return rows
+
+
+def ledger_totals(rows: list[dict]) -> dict:
+    """Per-kind byte totals plus the grand total."""
+    out = {KIND_CARRY: 0, KIND_CONSTANT: 0, KIND_TEMP: 0}
+    for r in rows:
+        out[r["kind"]] = out.get(r["kind"], 0) + r["nbytes"]
+    out["total"] = sum(out[k] for k in (KIND_CARRY, KIND_CONSTANT,
+                                        KIND_TEMP))
+    return out
+
+
+def reconcile_ledger(rows: list[dict], analysis: dict,
+                     tol: float = 0.01) -> dict:
+    """Gate: the ledger's carry total must match the executable's
+    live-argument accounting (``memory_analysis().argument_size_in_bytes``
+    — the tick donates its whole carry, so the two count the same
+    buffers) within ``tol``."""
+    carry = ledger_totals(rows)[KIND_CARRY]
+    arg = int(analysis["argument_bytes"])
+    ratio = carry / arg if arg else float("inf")
+    return {"carry_bytes": carry, "argument_bytes": arg,
+            "ratio": round(ratio, 6),
+            "ok": arg > 0 and abs(ratio - 1.0) <= tol}
+
+
+def budget_check(rows: list[dict], budget_mb: float,
+                 node_cnt: int = 1) -> dict:
+    """Does the per-node footprint (x node_cnt replicas cluster-wide)
+    fit an HBM budget?  Reports the (txn x access) tensor-plane share —
+    the B- and B*R-shaped arrays that scale with the in-flight window —
+    separately, because that is the axis the ROADMAP's million-user
+    scaling grows."""
+    tot = ledger_totals(rows)
+    budget = int(budget_mb * (1 << 20))
+    plane = sum(r["nbytes"] for r in rows
+                if r["kind"] == KIND_CARRY and len(r["shape"]) >= 1
+                and r["name"].split(".")[0] in ("txn", "net"))
+    per_node = tot["total"]
+    return {
+        "budget_bytes": budget,
+        "per_node_bytes": per_node,
+        "cluster_bytes": per_node * node_cnt,
+        "txn_plane_bytes": plane,
+        "headroom_bytes": budget - per_node,
+        "spill": per_node > budget,
+    }
+
+
+# ---------------------------------------------------------------------------
+# rendering
+# ---------------------------------------------------------------------------
+
+def roofline_markdown(rows: list[dict]) -> str:
+    """The generated PROFILE.md table (replaces the hand-maintained
+    primitive cost table for metered entry points)."""
+    head = ("| entry | calls | mean ms | MFLOP | MB touched | GFLOP/s | "
+            "GB/s | peak FLOP | peak BW | bound |")
+    sep = "|" + "---|" * 10
+    lines = [head, sep]
+    for r in rows:
+        lines.append(
+            f"| {r['entry']} | {r['calls']} | {r['mean_ms']:.3f} | "
+            f"{r['flops'] / 1e6:.2f} | {r['bytes_accessed'] / 1e6:.2f} | "
+            f"{r['achieved_gflops']:.2f} | {r['achieved_gbps']:.2f} | "
+            f"{r['peak_flop_frac']:.2%} | {r['peak_bw_frac']:.2%} | "
+            f"{r['bound']} |")
+    return "\n".join(lines)
+
+
+def ledger_text(rows: list[dict], top: int = 12) -> str:
+    tot = ledger_totals(rows)
+    lines = [f"[ledger] {tot['total'] / 1e6:.2f} MB resident "
+             f"(carry {tot[KIND_CARRY] / 1e6:.2f} / constant "
+             f"{tot[KIND_CONSTANT] / 1e6:.2f} / temp "
+             f"{tot[KIND_TEMP] / 1e6:.2f})"]
+    for r in sorted(rows, key=lambda r: -r["nbytes"])[:top]:
+        lines.append(f"  {r['name']:<32} {str(r['shape']):<16} "
+                     f"{r['dtype']:<8} {r['nbytes']:>12} {r['kind']}")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# CLI: HBM sizing tool
+# ---------------------------------------------------------------------------
+
+def fit_batch(budget_mb: float, probe_totals: dict[int, int],
+              node_cnt: int = 1) -> dict:
+    """Linear footprint model from two probe batch sizes: bytes(B) =
+    fixed + per_txn * B, solved for the largest B under the budget."""
+    (b0, t0), (b1, t1) = sorted(probe_totals.items())
+    per_txn = (t1 - t0) / max(b1 - b0, 1)
+    fixed = t0 - per_txn * b0
+    budget = budget_mb * (1 << 20)
+    max_b = int((budget - fixed) / per_txn) if per_txn > 0 else 0
+    return {"fixed_bytes": int(fixed), "per_txn_bytes": float(per_txn),
+            "max_batch_per_node": max(max_b, 0),
+            "max_batch_cluster": max(max_b, 0) * node_cnt}
+
+
+def main(argv=None) -> int:
+    import argparse
+    from deneva_tpu.config import Config
+    from deneva_tpu.engine.scheduler import Engine
+
+    p = argparse.ArgumentParser(
+        prog="python -m deneva_tpu.obs.xmeter",
+        description="HBM footprint ledger + sizing: flag when the "
+                    "(txn x access) plane would spill a budget at a "
+                    "target B/R/NODE_CNT, and report the max batch the "
+                    "budget admits")
+    p.add_argument("--budget-mb", type=float, required=True,
+                   help="HBM budget per node in MB (v5e chip: 16384)")
+    p.add_argument("--batch", type=int, default=8192,
+                   help="target in-flight txns per node (B)")
+    p.add_argument("--req", type=int, default=10,
+                   help="accesses per txn (R)")
+    p.add_argument("--rows", type=int, default=1 << 24,
+                   help="table rows (SYNTH_TABLE_SIZE)")
+    p.add_argument("--node-cnt", type=int, default=1,
+                   help="cluster nodes (footprint replicates per node)")
+    p.add_argument("--cc-alg", default="NO_WAIT")
+    p.add_argument("--json", action="store_true")
+    args = p.parse_args(argv)
+
+    def ledger_at(batch: int) -> list[dict]:
+        cfg = Config(cc_alg=args.cc_alg, batch_size=batch,
+                     synth_table_size=args.rows, req_per_query=args.req,
+                     query_pool_size=min(1 << 12, args.rows), xmeter=True)
+        eng = Engine(cfg)
+        return state_ledger(eng.init_state(),
+                            constants={"pool": eng.pool_dev})
+
+    # probe two small batches for the linear model, then evaluate the
+    # target batch exactly
+    probes = {b: ledger_totals(ledger_at(b))["total"] for b in (256, 512)}
+    target_rows = ledger_at(args.batch)
+    check = budget_check(target_rows, args.budget_mb,
+                         node_cnt=args.node_cnt)
+    fit = fit_batch(args.budget_mb, probes, node_cnt=args.node_cnt)
+    doc = {"target": {"batch": args.batch, "req": args.req,
+                      "rows": args.rows, "node_cnt": args.node_cnt,
+                      "cc_alg": args.cc_alg}, **check, **fit}
+    if args.json:
+        print(json.dumps(doc))
+    else:
+        print(ledger_text(target_rows))
+        print(f"[budget] per-node {check['per_node_bytes'] / 1e6:.2f} MB "
+              f"vs {args.budget_mb:.0f} MB budget -> "
+              f"{'SPILL' if check['spill'] else 'fits'} "
+              f"(txn-plane {check['txn_plane_bytes'] / 1e6:.2f} MB; "
+              f"cluster x{args.node_cnt} = "
+              f"{check['cluster_bytes'] / 1e6:.2f} MB)")
+        print(f"[budget] max B under budget: "
+              f"{fit['max_batch_per_node']} per node "
+              f"({fit['per_txn_bytes']:.0f} B/txn + "
+              f"{fit['fixed_bytes'] / 1e6:.2f} MB fixed)")
+    return 1 if check["spill"] else 0
+
+
+if __name__ == "__main__":          # pragma: no cover - CLI shim
+    raise SystemExit(main())

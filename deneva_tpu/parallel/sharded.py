@@ -63,6 +63,7 @@ from deneva_tpu.engine.scheduler import (STAT_KEYS_F32, STAT_KEYS_I32,  # noqa: 
 from deneva_tpu.obs import trace as obs_trace
 from deneva_tpu.obs.prog import ProgressEmitter
 from deneva_tpu.obs.profiler import PhaseProfiler
+from deneva_tpu.obs.xmeter import XMeter, ledger_totals, state_ledger
 from deneva_tpu.engine.state import (BIG_TS, NULL_KEY, STATUS_BACKOFF,
                                      STATUS_FREE, STATUS_RUNNING,
                                      STATUS_WAITING, TxnState)
@@ -1095,6 +1096,8 @@ class ShardedEngine:
         self._psum_fn = None     # lazy cluster-counter aggregator
         # host-side phase profiler (obs/profiler.py); None when disabled
         self.profiler = PhaseProfiler() if cfg.profile else None
+        # compile & memory observatory (obs/xmeter.py)
+        self.xmeter = XMeter(cfg) if cfg.xmeter else None
 
     def init_state(self) -> ShardState:
         cfg = self.cfg
@@ -1135,6 +1138,9 @@ class ShardedEngine:
         self._jit_tick = jax.jit(
             lambda st: f(st, self.pool_stacked, self._node_idx),
             donate_argnums=0)
+        if self.xmeter is not None:
+            self._jit_tick = self.xmeter.wrap("sharded_tick",
+                                              self._jit_tick)
 
     def run(self, n_ticks: int, state: ShardState | None = None,
             prog_every: int | None = None) -> ShardState:
@@ -1173,16 +1179,28 @@ class ShardedEngine:
         node_idx = (self._node_idx if self._jit_tick
                     else jnp.arange(N, dtype=jnp.int32))
         jf = jax.jit(f, donate_argnums=0)
-        if self.profiler is None:
-            return jf(state, self.pool_stacked, node_idx)
-        # a fresh jit is built each call, so every run_compiled recompiles:
-        # a combined trace/lower/compile+dispatch phase, then execute
-        self.profiler.count("jit_recompiles")
-        with self.profiler.phase("trace_lower_compile"):
-            out = jf(state, self.pool_stacked, node_idx)
-        with self.profiler.phase("execute"):
-            jax.block_until_ready(out)
-        return out
+
+        def dispatch():
+            if self.profiler is None:
+                return jf(state, self.pool_stacked, node_idx)
+            # a fresh jit is built each call, so every run_compiled
+            # recompiles: a combined trace/lower/compile+dispatch phase,
+            # then execute
+            self.profiler.count("jit_recompiles")
+            with self.profiler.phase("trace_lower_compile"):
+                out = jf(state, self.pool_stacked, node_idx)
+            with self.profiler.phase("execute"):
+                jax.block_until_ready(out)
+            return out
+
+        if self.xmeter is None:
+            return dispatch()
+        # the fresh jit above compiles EVERY call by construction: the
+        # sentinel records it so steady-state runs that lean on
+        # run_compiled after mark_warm are named, not silent
+        with self.xmeter.watch("sharded_scan", sig=n_ticks,
+                               expect_compile=True):
+            return dispatch()
 
     def _cluster_counters(self, state: ShardState) -> dict:
         """Device-side cluster reduction: every int32 scalar counter —
@@ -1248,7 +1266,20 @@ class ShardedEngine:
         out["ccl_valid"] = samples.shape[0]
         if wall_seconds is not None:
             out["tput"] = s["txn_cnt"] / wall_seconds
+        if self.xmeter is not None:
+            # merged ONLY when the observatory is on (byte-identical off
+            # path); hbm_bytes is the whole-cluster resident footprint
+            # (the state leaves are node-stacked, so the ledger already
+            # sums every shard's replica)
+            out.update(self.xmeter.summary_fields(
+                hbm_bytes=ledger_totals(self.ledger(state))["total"]))
         return out
+
+    def ledger(self, state: ShardState) -> list:
+        """Cluster HBM footprint rows (obs/xmeter.py state_ledger): the
+        node-stacked carry plus the replicated query-pool plane."""
+        return state_ledger(state,
+                            constants={"pool": self.pool_stacked})
 
     def summary_line(self, state: ShardState,
                      wall_seconds: float | None = None,

@@ -112,6 +112,15 @@ def reference_summary(s: dict, wall_seconds: float | None = None) -> dict:
         if k.endswith("_cnt") and k.startswith(_VERBATIM_PREFIXES) \
                 and k not in out:
             out[k] = s[k]
+    # compile & memory observatory keys (Config.xmeter, obs/xmeter.py)
+    # pass through verbatim too — present only when the engine summary
+    # carries them, so the default line stays byte-identical.  Prefix-
+    # restricted like the block above, but without the ``_cnt`` suffix
+    # requirement (compile_ms / hbm_bytes are not counters).
+    _XMETER_PREFIXES = ("compile_", "hbm_", "xmeter_")
+    for k in sorted(s):
+        if k.startswith(_XMETER_PREFIXES) and k not in out:
+            out[k] = s[k]
     # reference-name ALIASES for the invented chain counters, so parsers
     # of reference-format summaries (stats.cpp:907 prints case1..6) keep
     # their maat_caseN_cnt fields.  The reference's case2/4/5 fire against
@@ -188,6 +197,17 @@ def parse_summary(line: str) -> dict:
         return {}
     out = {}
     for r in re.split(",", line):
-        name, val = re.split("=", r)
-        out[name] = float(val)
+        # tolerate unknown FUTURE keys instead of crashing the parser:
+        # split once (values may themselves contain '='), keep
+        # non-numeric values verbatim, skip malformed records — the
+        # line is an append-only contract and old parsers must survive
+        # new observatory keys (the same passthrough discipline as the
+        # abort_* counters in reference_summary)
+        if "=" not in r:
+            continue
+        name, val = r.split("=", 1)
+        try:
+            out[name] = float(val)
+        except ValueError:
+            out[name] = val
     return out

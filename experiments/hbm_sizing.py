@@ -1,0 +1,88 @@
+"""HBM budget sizing sweep — the --budget-mb recipe behind EXPERIMENTS.md.
+
+Answers the capacity-planning question the xmeter ledger makes tractable:
+at R accesses per txn, how many in-flight txns (B) fit a per-node HBM
+budget, and which arrays spill first?  Probes the per-array footprint
+ledger (deneva_tpu/obs/xmeter.py state_ledger) at a few batch sizes —
+init_state only, no run, so the sweep is seconds even at paper-scale
+row counts — fits the linear bytes(B) = fixed + per_txn * B model, and
+prints the max batch per budget row.
+
+Usage:
+    python experiments/hbm_sizing.py [--req 10] [--rows $((1<<24))]
+        [--node-cnt 1] [--budgets-mb 1024,4096,16384]
+
+The same single-budget check (with spill flagging and exit code) is the
+``python -m deneva_tpu.obs.xmeter --budget-mb ...`` CLI; this sweep is
+the multi-budget planning view.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from deneva_tpu.config import Config  # noqa: E402
+from deneva_tpu.engine.scheduler import Engine  # noqa: E402
+from deneva_tpu.obs import xmeter as obs_xmeter  # noqa: E402
+
+#: probe batches for the linear model (small enough to allocate anywhere,
+#: far enough apart that per-txn slope dominates rounding)
+PROBES = (256, 1024)
+
+
+def ledger_at(batch: int, req: int, rows: int, cc_alg: str) -> list[dict]:
+    cfg = Config(cc_alg=cc_alg, batch_size=batch, synth_table_size=rows,
+                 req_per_query=req, query_pool_size=min(1 << 12, rows))
+    eng = Engine(cfg)
+    return obs_xmeter.state_ledger(eng.init_state(),
+                                   constants={"pool": eng.pool_dev})
+
+
+def sweep(budgets_mb, req: int, rows: int, node_cnt: int,
+          cc_alg: str) -> dict:
+    probes = {b: obs_xmeter.ledger_totals(
+        ledger_at(b, req, rows, cc_alg))["total"] for b in PROBES}
+    out = {"req": req, "rows": rows, "node_cnt": node_cnt,
+           "cc_alg": cc_alg, "probes": probes, "budgets": []}
+    for mb in budgets_mb:
+        fit = obs_xmeter.fit_batch(mb, probes, node_cnt=node_cnt)
+        out["budgets"].append({"budget_mb": mb, **fit})
+    return out
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--req", type=int, default=10)
+    p.add_argument("--rows", type=int, default=1 << 24)
+    p.add_argument("--node-cnt", type=int, default=1)
+    p.add_argument("--cc-alg", default="NO_WAIT")
+    p.add_argument("--budgets-mb", default="1024,4096,16384",
+                   help="comma-separated per-node budgets (v5e: 16384)")
+    p.add_argument("--json", action="store_true")
+    args = p.parse_args(argv)
+    budgets = [float(b) for b in args.budgets_mb.split(",") if b]
+
+    doc = sweep(budgets, args.req, args.rows, args.node_cnt, args.cc_alg)
+    if args.json:
+        print(json.dumps(doc))
+        return 0
+    fixed = doc["budgets"][0]["fixed_bytes"]
+    per_txn = doc["budgets"][0]["per_txn_bytes"]
+    print(f"[sizing] {args.cc_alg} R={args.req} rows={args.rows} "
+          f"nodes={args.node_cnt}: bytes(B) = {fixed / 1e6:.2f} MB + "
+          f"{per_txn:.0f} B/txn")
+    print("| budget MB/node | max B/node | max B cluster |")
+    print("|---|---|---|")
+    for row in doc["budgets"]:
+        print(f"| {row['budget_mb']:.0f} | {row['max_batch_per_node']} | "
+              f"{row['max_batch_cluster']} |")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

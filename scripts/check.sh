@@ -44,6 +44,32 @@ if [ "$obs_rc" -ne 0 ]; then
     exit "$obs_rc"
 fi
 
+echo "== xmeter smoke (recompile sentinel + ledger reconcile) =="
+# the compile & memory observatory on the same small cell: nonzero means
+# a post-warmup recompile (rc&1) or the HBM ledger disagreeing with the
+# compiled tick's own memory_analysis() by >1% (rc&2)
+xm_dir=$(mktemp -d)
+env JAX_PLATFORMS=cpu python bench.py --xmeter --ticks 40 \
+    --out-dir "$xm_dir"
+xm_rc=$?
+rm -rf "$xm_dir"
+if [ "$xm_rc" -ne 0 ]; then
+    echo "xmeter smoke FAILED (sentinel/ledger bitmask rc=$xm_rc)"
+    exit "$xm_rc"
+fi
+
+echo "== bench regression gate =="
+# gate the latest trajectory point (committed BENCH_r*.json snapshots +
+# any results/bench_history.jsonl) against the median of its priors;
+# exit code = number of regressions
+env JAX_PLATFORMS=cpu python -m deneva_tpu.obs.regress \
+    BENCH_r*.json results/
+regress_rc=$?
+if [ "$regress_rc" -ne 0 ]; then
+    echo "bench regression gate FAILED (rc=$regress_rc)"
+    exit "$regress_rc"
+fi
+
 echo "== tier-1 pytest =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

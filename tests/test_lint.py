@@ -196,6 +196,49 @@ GOOD_PAD_SORT_NO_VIEW = """
         return jax.lax.sort((key, ts), num_keys=1, is_stable=False)
 """
 
+BAD_JIT_IN_LOOP = """
+    import jax
+
+    def driver(fn, xs):
+        outs = []
+        for x in xs:
+            outs.append(jax.jit(fn)(x))
+        return outs
+"""
+
+BAD_PARTIAL_JIT_IN_LOOP = """
+    import functools
+
+    import jax
+
+    def driver(fn, x):
+        for _ in range(4):
+            step = functools.partial(jax.jit, donate_argnums=0)(fn)
+            x = step(x)
+        return x
+"""
+
+BAD_STATIC_ARGNUMS_IN_LOOP = """
+    def driver(wrap, fn, x):
+        n = 0
+        while n < 4:
+            step = wrap(fn, static_argnums=(0,))
+            x = step(n, x)
+            n += 1
+        return x
+"""
+
+GOOD_JIT_HOISTED = """
+    import jax
+
+    def driver(fn, xs):
+        step = jax.jit(fn)          # hoisted: one dispatch cache
+        outs = []
+        for x in xs:
+            outs.append(step(x))
+        return outs
+"""
+
 
 @pytest.mark.parametrize("code,rule", [
     (BAD_TRACED_BRANCH, "TRACED-BRANCH"),
@@ -206,8 +249,12 @@ GOOD_PAD_SORT_NO_VIEW = """
     (BAD_HOST, "HOST-CALL"),
     (BAD_SCATTER, "SCATTER-RACE"),
     (BAD_PAD_SORT, "PAD-WIDTH-SORT"),
+    (BAD_JIT_IN_LOOP, "COMPILE-IN-LOOP"),
+    (BAD_PARTIAL_JIT_IN_LOOP, "COMPILE-IN-LOOP"),
+    (BAD_STATIC_ARGNUMS_IN_LOOP, "COMPILE-IN-LOOP"),
 ], ids=["traced-branch", "concretize-int", "concretize-item", "data-dep",
-        "implicit-dtype", "host-call", "scatter-race", "pad-width-sort"])
+        "implicit-dtype", "host-call", "scatter-race", "pad-width-sort",
+        "jit-in-loop", "partial-jit-in-loop", "static-argnums-in-loop"])
 def test_bad_fixture_is_flagged(tmp_path, code, rule):
     assert rule in active_rules(lint_src(tmp_path, code))
 
@@ -215,10 +262,10 @@ def test_bad_fixture_is_flagged(tmp_path, code, rule):
 @pytest.mark.parametrize("code", [
     GOOD_TRACED_BRANCH, GOOD_DATA_DEP, GOOD_DTYPE, GOOD_HOST,
     GOOD_SCATTER_ADD, GOOD_SCATTER_UNIQUE, GOOD_SCATTER_ARANGE,
-    GOOD_PAD_SORT_COMPACTED, GOOD_PAD_SORT_NO_VIEW,
+    GOOD_PAD_SORT_COMPACTED, GOOD_PAD_SORT_NO_VIEW, GOOD_JIT_HOISTED,
 ], ids=["where", "sized-nonzero", "explicit-dtype", "host-outside-kernel",
         "commutative-add", "declared-unique", "arange-index",
-        "sort-on-compacted", "sort-without-view"])
+        "sort-on-compacted", "sort-without-view", "jit-hoisted"])
 def test_good_fixture_is_clean(tmp_path, code):
     assert active_rules(lint_src(tmp_path, code)) == []
 

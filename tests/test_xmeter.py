@@ -1,0 +1,262 @@
+"""Compile & memory observatory tests (deneva_tpu/obs/xmeter.py +
+obs/regress.py, Config.xmeter): recompile-sentinel exactness across all
+seven CC algorithms, shape-varying recompile detection, the HBM ledger
+reconciled against both the raw state pytree and the compiled tick's own
+memory_analysis(), the roofline row schema, the bench regression gate
+(passes the repo's real trajectory, fails a synthetic 20% drop), the
+budget/sizing helpers, and the off path's byte-identical [summary]."""
+
+import glob
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deneva_tpu import stats as stats_mod
+from deneva_tpu.config import Config
+from deneva_tpu.engine.scheduler import Engine
+from deneva_tpu.obs import regress as obs_regress
+from deneva_tpu.obs import report as obs_report
+from deneva_tpu.obs import trace as obs_trace
+from deneva_tpu.obs import xmeter as obs_xmeter
+
+BASE = dict(cc_alg="NO_WAIT", batch_size=128, synth_table_size=1 << 10,
+            req_per_query=4, zipf_theta=0.8, query_pool_size=1 << 10)
+
+ALL_ALGS = ("NO_WAIT", "WAIT_DIE", "TIMESTAMP", "MVCC", "OCC", "MAAT",
+            "CALVIN")
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_metered(n_ticks=12, **kw):
+    eng = Engine(Config(**{**BASE, **kw}, xmeter=True))
+    return eng, eng.run(n_ticks)
+
+
+# ---- recompile sentinel ---------------------------------------------------
+
+@pytest.mark.parametrize("alg", ALL_ALGS)
+def test_exact_compile_counts_per_alg(alg):
+    # ONE compile per entry point across warmup + steady state: the tick
+    # jit and the final flush.  A second run after mark_warm must hit the
+    # dispatch cache every call — zero violations, zero new compiles.
+    eng, st = run_metered(cc_alg=alg)
+    xm = eng.xmeter
+    assert xm.entries["tick"].compile_cnt == 1
+    assert xm.entries["flush_writes"].compile_cnt == 1
+    xm.mark_warm()
+    eng.run(12, st)
+    assert xm.steady_violations() == []
+    assert xm.entries["tick"].compile_cnt == 1
+
+
+def test_shape_varying_call_is_caught_and_named():
+    xm = obs_xmeter.XMeter()
+    f = xm.wrap("grow", jax.jit(lambda x: x + 1))
+    f(jnp.zeros(8, jnp.int32))
+    xm.mark_warm()
+    f(jnp.zeros(16, jnp.int32))        # new shape -> new compile, post-warm
+    assert xm.entries["grow"].compile_cnt == 2
+    viol = xm.steady_violations()
+    assert len(viol) == 1 and viol[0]["entry"] == "grow"
+    assert viol[0]["signature"] is not None
+
+
+def test_summary_fields_round_trip_the_line():
+    eng, st = run_metered()
+    line = eng.summary_line(st)
+    parsed = stats_mod.parse_summary(line)
+    assert parsed["compile_cnt"] == 2.0      # tick + flush_writes
+    assert parsed["compile_ms"] > 0
+    assert parsed["hbm_bytes"] > 0
+
+
+# ---- HBM footprint ledger -------------------------------------------------
+
+def test_ledger_carry_total_equals_state_nbytes():
+    eng, st = run_metered()
+    rows = eng.ledger(st)
+    tot = obs_xmeter.ledger_totals(rows)
+    want = sum(np.asarray(x).nbytes for x in jax.tree_util.tree_leaves(st))
+    assert tot[obs_xmeter.KIND_CARRY] == want
+    # every row names a real array with consistent bookkeeping
+    for r in rows:
+        assert r["nbytes"] == int(np.prod(r["shape"]) or 1) * \
+            np.dtype(r["dtype"]).itemsize
+
+
+def test_ledger_reconciles_with_memory_analysis():
+    # the tick donates its whole carry, so the executable's argument
+    # accounting and the ledger's carry total count the same buffers
+    eng, st = run_metered()
+    analysis = eng.xmeter.analyze("tick")
+    rec = obs_xmeter.reconcile_ledger(eng.ledger(st), analysis)
+    assert rec["ok"], rec
+    assert abs(rec["ratio"] - 1.0) <= 0.01
+
+
+def test_budget_check_flags_spill():
+    eng, st = run_metered()
+    rows = eng.ledger(st)
+    tight = obs_xmeter.budget_check(rows, budget_mb=1e-4)
+    roomy = obs_xmeter.budget_check(rows, budget_mb=1024)
+    assert tight["spill"] and not roomy["spill"]
+    assert 0 < tight["txn_plane_bytes"] <= tight["per_node_bytes"]
+    assert roomy["cluster_bytes"] == roomy["per_node_bytes"]
+
+
+def test_fit_batch_linear_model():
+    # bytes(B) = 1000 + 10*B, budget 1 MB -> max B = (2**20 - 1000) / 10
+    fit = obs_xmeter.fit_batch(1.0, {256: 1000 + 2560, 512: 1000 + 5120})
+    assert fit["fixed_bytes"] == 1000
+    assert fit["per_txn_bytes"] == 10.0
+    assert fit["max_batch_per_node"] == int(((1 << 20) - 1000) / 10)
+    assert obs_xmeter.fit_batch(
+        1.0, {256: 3560, 512: 6120}, node_cnt=4)["max_batch_cluster"] == \
+        4 * fit["max_batch_per_node"]
+
+
+# ---- roofline -------------------------------------------------------------
+
+def test_roofline_row_schema():
+    eng, st = run_metered()
+    eng.xmeter.block = True
+    st = eng.run(8, st)                  # blocked calls -> wall-true ms
+    eng.xmeter.analyze("tick")
+    rows = eng.xmeter.roofline()
+    row = next(r for r in rows if r["entry"] == "tick")
+    for key in ("entry", "calls", "mean_ms", "flops", "bytes_accessed",
+                "achieved_gflops", "achieved_gbps", "peak_flop_frac",
+                "peak_bw_frac", "bound"):
+        assert key in row
+    assert row["mean_ms"] > 0 and row["calls"] >= 8
+    assert row["peak_flop_frac"] > 0 and row["peak_bw_frac"] > 0
+    assert row["bound"] in ("memory", "compute")
+    md = obs_xmeter.roofline_markdown(rows)
+    assert md.splitlines()[0].startswith("| entry |")
+    assert "| tick |" in md
+
+
+def test_snapshot_schema_and_report_section():
+    eng, st = run_metered()
+    eng.xmeter.block = True
+    st = eng.run(8, st)
+    eng.xmeter.analyze("tick")
+    snap = eng.xmeter.snapshot()
+    assert snap["schema"] == obs_xmeter.SNAPSHOT_SCHEMA
+    assert snap["compile_cnt"] == 2 and "tick" in snap["entries"]
+    json.dumps(snap)                     # JSON-serializable end to end
+    rep = obs_report.build_report(eng.summary(st), xmeter=snap)
+    text = obs_report.render_text(rep)
+    assert "[compile]" in text and "[roofline]" in text
+    assert "tick" in text
+
+
+def test_chrome_trace_fifth_track(tmp_path):
+    eng, st = run_metered(trace_ticks=16)
+    eng.xmeter.block = True
+    st = eng.run(8, st)
+    snap = eng.xmeter.snapshot()
+    p1 = obs_trace.to_chrome_trace(st, str(tmp_path / "with.json"),
+                                   xmeter=snap)
+    doc = json.load(open(p1))
+    kernel = [e for e in doc["traceEvents"] if e["name"] == "kernel ms"]
+    assert kernel and all(e["ph"] == "C" for e in kernel)
+    assert "tick" in doc["metadata"]["xmeter_entries"]
+    # the 5-track schema is opt-in: no snapshot, no track (compatibility)
+    p2 = obs_trace.to_chrome_trace(st, str(tmp_path / "without.json"))
+    doc2 = json.load(open(p2))
+    assert not any(e["name"] == "kernel ms" for e in doc2["traceEvents"])
+    assert "xmeter_entries" not in doc2["metadata"]
+
+
+# ---- off-path parity ------------------------------------------------------
+
+def test_xmeter_off_summary_is_byte_identical():
+    off = Engine(Config(**BASE))
+    on = Engine(Config(**BASE, xmeter=True))
+    assert off.xmeter is None and on.xmeter is not None
+    line_off = off.summary_line(off.run(10))
+    line_on = on.summary_line(on.run(10))
+    s_off = stats_mod.parse_summary(line_off)
+    s_on = stats_mod.parse_summary(line_on)
+    extra = set(s_on) - set(s_off)
+    assert extra == {"compile_cnt", "compile_ms", "hbm_bytes"}
+    # host-only keys aside, the two lines agree byte for byte: the meter
+    # must not perturb the schedule
+    host_keys = {"mem_util", "cpu_util", "total_runtime", "tput"}
+    for k in s_off:
+        if k in host_keys or k.startswith("ccl"):
+            continue
+        assert s_off[k] == s_on[k], k
+
+
+def test_parse_summary_tolerates_unknown_future_keys():
+    parsed = stats_mod.parse_summary(
+        "[summary] txn_cnt=5,weird=hello,x=a=b,malformed,new_cnt=2")
+    assert parsed["txn_cnt"] == 5.0
+    assert parsed["weird"] == "hello"    # non-numeric kept verbatim
+    assert parsed["x"] == "a=b"          # split once: '=' in value is ok
+    assert parsed["new_cnt"] == 2.0
+    assert "malformed" not in parsed
+
+
+# ---- bench regression gate ------------------------------------------------
+
+def _snap(tmp_path, n, value, cpt, rc=0):
+    doc = {"n": n, "rc": rc,
+           "parsed": None if rc else {
+               "metric": "ycsb_nowait_zipf0.6_tput_faithful",
+               "value": value,
+               "algs": {"NO_WAIT": {"commits_per_tick": cpt}}}}
+    p = tmp_path / f"BENCH_r{n:02d}.json"
+    p.write_text(json.dumps(doc))
+    return str(p)
+
+
+def test_regress_passes_real_trajectory():
+    snaps = sorted(glob.glob(os.path.join(REPO_ROOT, "BENCH_r*.json")))
+    assert len(snaps) >= 3, "repo trajectory missing"
+    rc = obs_regress.main(snaps + [os.path.join(REPO_ROOT, "results")])
+    assert rc == 0
+
+
+def test_regress_fails_synthetic_20pct_drop(tmp_path, capsys):
+    paths = [_snap(tmp_path, n, 100.0, 100.0) for n in (1, 2, 3)]
+    paths.append(_snap(tmp_path, 4, 100.0, 80.0))   # cpt -20% > 15% tol
+    rc = obs_regress.main(paths)
+    assert rc == 1
+    assert "FAIL commits_per_tick[NO_WAIT]" in capsys.readouterr().out
+
+
+def test_regress_skips_failed_snapshots_and_arms_gates(tmp_path, capsys):
+    assert obs_regress.load_snapshot(
+        _snap(tmp_path, 2, None, None, rc=1)) is None
+    # a failed round in the middle of the trajectory is not a data point
+    paths = [_snap(tmp_path, 1, 100.0, 100.0),
+             _snap(tmp_path, 2, None, None, rc=1),
+             _snap(tmp_path, 3, 99.0, 99.0)]
+    rc = obs_regress.main(paths)
+    assert rc == 0
+    entries = obs_regress.load_trajectory(paths)
+    assert [e["value"] for e in entries] == [100.0, 99.0]
+    # gates with no prior data self-arm (skip, not fail)
+    res = obs_regress.gate([entries[0]])
+    assert res["failures"] == [] and res["skipped"]
+
+
+def test_regress_reads_bench_history_jsonl(tmp_path):
+    hist = tmp_path / "bench_history.jsonl"
+    lines = [json.dumps({"unix_time": 100 + i, "metric": "m",
+                         "value": 50.0, "algs": {"OCC": 10.0}})
+             for i in range(3)]
+    hist.write_text("\n".join(lines + ["{not json"]) + "\n")
+    entries = obs_regress.load_trajectory([str(tmp_path)])
+    assert len(entries) == 3             # malformed line skipped
+    res = obs_regress.gate(entries)
+    assert res["failures"] == []
+    assert any(c["name"] == "commits_per_tick[OCC]" for c in res["checks"])
