@@ -32,6 +32,13 @@ entry point and fails the run — plus the HBM footprint ledger reconciled
 against the compiled tick's own ``memory_analysis()`` and the generated
 per-kernel roofline table.  scripts/check.sh gates on its exit code.
 
+With ``--offered-load`` the script runs the open-system saturation sweep
+(deneva_tpu/traffic/, Config.arrival): a Poisson arrival-rate grid per CC
+algorithm on the small observed cell, finding each algorithm's saturation
+KNEE (the highest rate still served with served/offered >= 0.95) and
+recording p50/p99 latency, queue depth and the OVERLOAD watchdog bit per
+point into ``offered_load_sweep.json``.  EXPERIMENTS.md has the recipe.
+
 Every headline run additionally APPENDS one JSON line to
 ``<out-dir>/bench_history.jsonl`` (unix time, git commit, config
 fingerprint, headline value, per-algorithm cells) — the trajectory that
@@ -191,6 +198,111 @@ def run_obs(args) -> int:
     return code
 
 
+def run_offered_load(args, out_dir: str = "results",
+                     history: bool = True) -> int:
+    """--offered-load: open-system saturation sweep (deneva_tpu/traffic/).
+
+    Walks a Poisson arrival-rate grid per CC algorithm on the small
+    observed cell and finds the saturation KNEE — the highest offered
+    rate the engine still serves: ``served_frac`` = admissions/arrivals
+    must stay >= 0.95 with a drained run-end queue, i.e. no OVERLOAD
+    (below the knee the queue drains; past it backlog grows without
+    bound and the OVERLOAD watchdog bit fires).  Each
+    point records offered vs served rate, commits/tick, the short
+    (ccl50/ccl99) and long (famlat p50/p99, restarts + queueing behind
+    admission included) latency percentiles, final/peak queue depth and
+    the watchdog bitmask.  Writes ``<out-dir>/offered_load_sweep.json``,
+    prints the headline JSON line and appends an
+    ``offered_load_knee`` record (knee + per-alg commits/tick at the
+    knee) to the bench history for the regression gate.
+
+    Exit code 0 when every algorithm produced a knee and every
+    sub-knee point stayed OVERLOAD-free; 1 otherwise."""
+    from deneva_tpu import stats as stats_mod
+    from deneva_tpu.obs import report as obs_report
+    rates = [float(r) for r in args.rates.split(",") if r]
+    alg_list = (list(_ALGS) if args.algs == "all"
+                else [a.strip().upper() for a in args.algs.split(",") if a])
+    sweep, knees, algs_hist = {}, {}, {}
+    code = 0
+    for alg in alg_list:
+        points = []
+        for rate in rates:
+            cfg = Config(cc_alg=alg, arrival="poisson", arrival_rate=rate,
+                         **OBS_KW)
+            eng = Engine(cfg)
+            state = eng.run(args.ticks)
+            s = eng.summary(state)
+            ticks = max(s["measured_ticks"], 1)
+            arrived = s["arrival_cnt"] / ticks
+            served = s["queue_admit_cnt"] / ticks
+            frac = served / max(arrived, 1e-9)
+            ccl = stats_mod.latency_percentiles(s["ccl_samples"],
+                                                s["ccl_valid"])
+            _, wd = obs_report.watchdog(s)
+            points.append({
+                "offered": rate,
+                "arrived_per_tick": round(arrived, 2),
+                "served_per_tick": round(served, 2),
+                "served_frac": round(frac, 4),
+                "commits_per_tick": round(s["txn_cnt"] / ticks, 2),
+                "p50": ccl["ccl50"], "p99": ccl["ccl99"],
+                "famlat_p50": s.get("famlat0_p50", 0.0),
+                "famlat_p99": s.get("famlat0_p99", 0.0),
+                "queue_len": s["queue_len"],
+                "queue_peak": s["queue_peak"],
+                "watchdog": wd,
+            })
+        sweep[alg] = points
+        # a knee point must both serve >= 95% of offered AND end with a
+        # drained queue (no OVERLOAD) — a borderline cell that squeaks
+        # past 0.95 while carrying run-end backlog is already saturated
+        ok = [p for p in points if p["served_frac"] >= 0.95
+              and not p["watchdog"] & obs_report.OVERLOAD]
+        knee = max((p["offered"] for p in ok), default=0.0)
+        knees[alg] = knee
+        at_knee = next((p for p in points if p["offered"] == knee), None)
+        if at_knee is None:
+            code = 1
+        else:
+            algs_hist[f"{alg}@knee"] = {
+                "commits_per_tick": at_knee["commits_per_tick"]}
+        # a sub-knee point must never trip OVERLOAD (backlog drains)
+        if any(p["watchdog"] & obs_report.OVERLOAD
+               for p in points if p["offered"] <= knee):
+            code = 1
+    doc = {
+        "metric": "offered_load_knee",
+        "value": knees.get("NO_WAIT", next(iter(knees.values()), 0.0)),
+        "unit": "arrivals_per_tick",
+        "ticks": args.ticks,
+        "offered_load": rates,
+        "knee": knees,
+        "algs": algs_hist,
+        "sweep": sweep,
+        "note": "knee = max Poisson rate with served/offered >= 0.95 and "
+                "a drained run-end queue (no OVERLOAD) on the small "
+                "observed cell (OBS_KW); served = admissions through the "
+                "traffic/ backpressure gate; past the knee the admission "
+                "queue grows and OVERLOAD (16) fires",
+    }
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, "offered_load_sweep.json")
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(json.dumps({k: v for k, v in doc.items() if k != "sweep"}))
+    print(f"[offered-load] sweep written: {path}")
+    if history:
+        _append_history(doc, Config(cc_alg=alg_list[0], arrival="poisson",
+                                    arrival_rate=rates[0], **OBS_KW),
+                        out_dir)
+    return code
+
+
+_ALGS = ("NO_WAIT", "WAIT_DIE", "TIMESTAMP", "MVCC", "OCC", "MAAT",
+         "CALVIN")
+
+
 def _git_commit() -> str | None:
     try:
         out = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
@@ -218,6 +330,13 @@ def _append_history(doc: dict, cfg: Config, out_dir: str = "results") -> str:
         rec["commits_per_tick"] = doc["commits_per_tick"]
     if "algs" in doc:
         rec["algs"] = doc["algs"]
+    # open-system sweep provenance (--offered-load): the rate grid and
+    # per-algorithm knee ride along; regress keys the trajectory on the
+    # distinct "offered_load_knee" metric + "<ALG>@knee" cells, so the
+    # headline tput trajectories are untouched
+    for k in ("offered_load", "knee"):
+        if k in doc:
+            rec[k] = doc[k]
     os.makedirs(out_dir, exist_ok=True)
     path = os.path.join(out_dir, "bench_history.jsonl")
     with open(path, "a") as f:
@@ -366,6 +485,17 @@ def _cli():
                    help="run ONLY this algorithm's headline YCSB cell "
                         "(faithful, acquire_window=1) and print the same "
                         "one-line JSON")
+    p.add_argument("--offered-load", action="store_true",
+                   help="open-system saturation sweep: walk a Poisson "
+                        "arrival-rate grid per CC algorithm to the "
+                        "saturation knee (served/offered >= 0.95) and "
+                        "write offered_load_sweep.json")
+    p.add_argument("--rates", default="2,4,8,16,32,64",
+                   help="comma-separated arrival-rate grid for "
+                        "--offered-load (arrivals/tick)")
+    p.add_argument("--algs", default="all",
+                   help="comma-separated CC algorithms for "
+                        "--offered-load (default: all seven)")
     p.add_argument("--xmeter", action="store_true",
                    help="compile & memory observatory smoke: recompile "
                         "sentinel + ledger reconcile + roofline "
@@ -381,6 +511,9 @@ def _cli():
 
 if __name__ == "__main__":
     _args = _cli()
+    if _args.offered_load:
+        raise SystemExit(run_offered_load(_args, out_dir=_args.out_dir,
+                                          history=not _args.no_history))
     if _args.xmeter:
         raise SystemExit(run_xmeter(_args))
     if _args.trace or _args.profile or _args.prog_interval:

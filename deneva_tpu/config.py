@@ -47,6 +47,9 @@ MODE_QRY_ONLY = "QRY_ONLY"   # NOCC + no row writes applied
 MODE_SIMPLE = "SIMPLE"       # ack immediately: commit without executing
 MODES = (MODE_NORMAL, MODE_NOCC, MODE_QRY_ONLY, MODE_SIMPLE)
 
+# Open-system arrival models (deneva_tpu/traffic/arrival.py)
+ARRIVAL_MODELS = ("poisson", "mmpp", "step")
+
 
 @dataclasses.dataclass(frozen=True)
 class Config:
@@ -94,6 +97,32 @@ class Config:
     #: ~B/8 shrinks it 8x with no steady-state effect.  Parity runs leave
     #: this None (the oracle admits into every free slot).
     admit_cap: Optional[int] = None
+
+    #: open-system arrival model (deneva_tpu/traffic/): the device-
+    #: resident analog of the reference's dedicated client processes
+    #: (client/client_main.cpp) driving open-loop load into the server
+    #: work queue.  None (default) keeps the closed loop — every free
+    #: slot refills instantly, no extra arrays are carried, and the tick
+    #: graph / [summary] line stay byte-identical.  "poisson" draws
+    #: Poisson(arrival_rate) arrivals per tick from a carried PRNG key;
+    #: "mmpp" adds a 2-state calm/burst regime (Markov-modulated
+    #: Poisson); "step" follows the piecewise-constant
+    #: ``arrival_schedule`` (flash crowds / rate steps — schedule points
+    #: are baked trace constants, so rate changes cause zero steady-
+    #: state recompiles).  Arrivals beyond what admission can take queue
+    #: in a carried backlog (``queue_len``); nothing is ever dropped
+    #: (arrival_cnt == queue_admit_cnt + queue_len holds exactly), and
+    #: the backlog integral becomes the real ``lat_work_queue_time``.
+    arrival: Optional[str] = None
+    arrival_rate: float = 0.0        # mean arrivals/tick (mmpp: calm rate)
+    arrival_burst_rate: float = 0.0  # mmpp burst-regime rate
+    arrival_p_burst: float = 0.01    # mmpp calm->burst switch prob per tick
+    arrival_p_calm: float = 0.10     # mmpp burst->calm switch prob per tick
+    arrival_schedule: tuple = ()     # "step": ((tick, rate), ...) ascending
+    arrival_seed: int = 7            # arrival-stream PRNG seed
+    #: per-family long-latency sampling ring depth (famlat* percentiles;
+    #: arrival runs only — the closed loop carries no family rings)
+    fam_lat_samples: int = 1 << 12
 
     #: commit-phase placement within the tick (single-shard engine).
     #: False (default): commit runs BEFORE the access phase — a txn whose
@@ -359,6 +388,24 @@ class Config:
                 "AP needs worker/replica mesh halves"
             assert self.part_cnt == self.node_cnt // 2, \
                 "AP: partitions live on the worker half only"
+        if self.arrival is not None:
+            assert self.arrival in ARRIVAL_MODELS, self.arrival
+            if self.arrival == "step":
+                assert self.arrival_schedule, \
+                    "step arrival needs a (tick, rate) schedule"
+                pts = [tuple(p) for p in self.arrival_schedule]
+                assert all(len(p) == 2 and p[1] >= 0 for p in pts), pts
+                ticks = [p[0] for p in pts]
+                assert ticks == sorted(ticks), \
+                    "arrival_schedule ticks must ascend"
+            else:
+                assert self.arrival_rate > 0, \
+                    "poisson/mmpp arrival needs arrival_rate > 0"
+            if self.arrival == "mmpp":
+                assert self.arrival_burst_rate > 0
+                assert 0.0 <= self.arrival_p_burst <= 1.0
+                assert 0.0 <= self.arrival_p_calm <= 1.0
+            assert self.fam_lat_samples > 0
         # the conflict histogram hashes with a multiplicative shift, so
         # the bin count must be a power of two (obs: engine heatmap)
         assert self.heatmap_bins >= 0 and \

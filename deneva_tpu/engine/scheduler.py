@@ -33,6 +33,7 @@ from deneva_tpu import cc as cc_registry
 from deneva_tpu import workloads as wl_registry
 from deneva_tpu.cc import base as cc_base
 from deneva_tpu.config import Config
+from deneva_tpu import traffic
 from deneva_tpu.obs import trace as obs_trace
 from deneva_tpu.obs.prog import ProgressEmitter
 from deneva_tpu.obs.profiler import PhaseProfiler
@@ -97,11 +98,16 @@ WAIT_DEPTH_BINS = 16
 
 
 def _zeros_stats(cfg: Config | None = None,
-                 wr_ring_shape: tuple[int, int] | None = None) -> dict:
+                 wr_ring_shape: tuple[int, int] | None = None,
+                 n_families: int = 1) -> dict:
     s = {k: jnp.zeros((), jnp.int32) for k in STAT_KEYS_I32}
     s.update({k: jnp.zeros((), jnp.float32) for k in STAT_KEYS_F32})
     s["arr_lat_short"] = jnp.zeros(LAT_SAMPLES, jnp.int32)
     s["lat_ring_cursor"] = jnp.zeros((), jnp.int32)
+    if cfg is not None and cfg.arrival is not None:
+        # open-system client plane (deneva_tpu/traffic/): carried PRNG
+        # key, admission backlog counters, per-family latency rings
+        s.update(traffic.init_arrival(cfg, n_families))
     if wr_ring_shape is not None:
         # committed-write buffer (see commit_block: the (n_rows,) scatter
         # is deferred out of the hot tick; flushed by cond when filling
@@ -487,8 +493,22 @@ def make_tick(cfg: Config, plugin, pool_dev: dict, workload=None):
             cap = min(cap, cfg.epoch_size)
             gate = gate + jnp.sum(expire.astype(jnp.int32))
         cap = min(cap, cfg.batch_size, Q)
-        free = free & (gate < cap)
+        admit_ok = gate < cap
+        if cfg.arrival is not None:
+            # open-system backpressure (deneva_tpu/traffic/): a fresh
+            # admission additionally consumes a queued client txn —
+            # backlog plus this tick's sampled arrivals.  The pool fetch
+            # keeps its STATIC cap (pool_admit's arange block); arrivals
+            # only mask admission lanes, so the jaxpr is rate-independent
+            # and rate changes never recompile.  Admitted franks stay a
+            # dense prefix (both gates are prefix conditions in frank).
+            n_arr, stats = traffic.sample_arrivals(cfg, stats, t)
+            avail = stats["queue_len"] + n_arr
+            admit_ok = admit_ok & (frank < avail)
+        free = free & admit_ok
         n_free = jnp.sum(free.astype(jnp.int32))
+        if cfg.arrival is not None:
+            stats = traffic.note_admission(stats, avail, n_free, measuring)
 
         keys, is_write, n_req, txn_type, targs, aux, pool_idx = pool_admit(
             pool_dev, txn, free, frank, state.pool_cursor, cap, Q)
@@ -615,6 +635,9 @@ def make_tick(cfg: Config, plugin, pool_dev: dict, workload=None):
                                         measuring)
             stats = record_commit_latency(stats, commit, t, txn.start_tick,
                                           measuring)
+            stats = traffic.record_family_latency(
+                stats, commit, txn.txn_type, t - txn.first_start_tick,
+                measuring)
             stats = bump(stats, "unique_txn_abort_cnt",
                          jnp.sum((commit
                                   & (txn.restarts > 0)).astype(jnp.int32)),
@@ -806,6 +829,7 @@ def make_tick(cfg: Config, plugin, pool_dev: dict, workload=None):
                 lock_wait=jnp.sum(wait.astype(jnp.int32)),
                 live_entries=live_delta, compact_ovf=ovf_delta)
             stats = obs_trace.record_reasons(stats, t)
+            stats = obs_trace.record_queue(stats, t)
 
         # ts wraparound guard: only relative order matters, and every live
         # txn's ts lies within [ts_counter - horizon, ts_counter], so rebase
@@ -897,7 +921,8 @@ class Engine:
             data=jnp.zeros(self.n_rows, jnp.int32),
             tables=self.workload.init_tables(cfg, 0),
             stats=_zeros_stats(cfg, wr_ring_shape=(
-                (B, R) if cfg.mode in (MODE_NORMAL, MODE_NOCC) else None)),
+                (B, R) if cfg.mode in (MODE_NORMAL, MODE_NOCC) else None),
+                n_families=int(self.pool.txn_type.max()) + 1),
             tick=jnp.zeros((), jnp.int32),
             pool_cursor=jnp.zeros((), jnp.int32),
             ts_counter=jnp.ones((), jnp.int32),
@@ -1006,6 +1031,11 @@ class Engine:
         n_valid = min(s["lat_ring_cursor"], ring.shape[0])
         out["ccl_samples"] = tuple(ring[:n_valid].tolist())
         out["ccl_valid"] = n_valid
+        if "arr_fam_lat" in state.stats:
+            # per-family long-latency percentiles (the open-system SLO
+            # view; arrival runs only — deneva_tpu/traffic/)
+            out.update(traffic.family_percentiles(
+                state.stats["arr_fam_lat"], state.stats["arr_fam_cursor"]))
         if wall_seconds is not None:
             out["tput"] = s["txn_cnt"] / wall_seconds
         if self.xmeter is not None:

@@ -17,6 +17,12 @@ bench.py runs — and gates two families:
   clock).  Default ``--cpt-tolerance 0.15``: a 20% drop in any
   algorithm's cell fails the gate.
 
+Open-system sweep records (bench.py ``--offered-load``) join the same
+trajectory under their own ``offered_load_knee`` metric and
+``<ALG>@knee`` cells; their per-algorithm saturation knee is gated like
+commits_per_tick (a knee collapse = the engine saturates earlier than it
+used to).
+
 A gate with no prior data (e.g. per-alg cells first appeared in round 5)
 is SKIPPED with a note, not failed — the gate self-arms as history
 accumulates.  Exit code = number of regressions (0 == clean), wired
@@ -65,8 +71,22 @@ def _entry(source: str, order: tuple, doc: dict) -> Optional[dict]:
         c = _cpt(cell)
         if c is not None:
             algs[alg] = c
-    return {"source": source, "order": order, "metric": metric,
-            "value": value, "algs": algs}
+    out = {"source": source, "order": order, "metric": metric,
+           "value": value, "algs": algs}
+    # open-system sweep records (bench.py --offered-load) carry the rate
+    # grid and the per-algorithm saturation knee; older records without
+    # them normalize to an empty dict, so mixed trajectories keep
+    # loading and the knee gate self-arms like the per-alg cells did
+    knees = {}
+    for alg, v in (doc.get("knee") or {}).items():
+        try:
+            knees[alg] = float(v)
+        except (TypeError, ValueError):
+            continue
+    out["knees"] = knees
+    if "offered_load" in doc:
+        out["offered_load"] = doc["offered_load"]
+    return out
 
 
 def load_snapshot(path: str) -> Optional[dict]:
@@ -162,6 +182,15 @@ def gate(entries: list[dict], current: Optional[dict] = None,
     for alg, cur in sorted(current["algs"].items()):
         check(f"commits_per_tick[{alg}]", cur,
               [e["algs"][alg] for e in prior if alg in e["algs"]],
+              cpt_tolerance)
+    # saturation-knee trajectory (--offered-load records): an
+    # algorithm's knee collapsing means it saturates at a lower offered
+    # rate than it used to — the same schedule-pure gate as
+    # commits_per_tick, so it shares that tolerance
+    for alg, cur in sorted(current.get("knees", {}).items()):
+        check(f"offered_load_knee[{alg}]", cur,
+              [e["knees"][alg] for e in prior
+               if alg in e.get("knees", {})],
               cpt_tolerance)
     return {"current": current, "checks": checks, "failures": failures,
             "skipped": skipped}

@@ -23,6 +23,9 @@ findings with a process exit bitmask::
     LIVELOCK  (2)  a zero-commit window with live abort/admission churn
     SPILL     (4)  compaction spill storm (forced-retry pressure)
     STARVED   (8)  a shard committing nothing while the cluster commits
+    OVERLOAD (16)  open-system run ended with more than ~1 service
+                   tick of admission backlog still queued (offered
+                   load exceeded the saturation knee and never drained)
 
 CLI: ``python -m deneva_tpu.obs.report <run_record.json> [--json]``
 exits with the watchdog bitmask, so a CI stage can gate on it
@@ -40,6 +43,7 @@ RECONCILE = 1
 LIVELOCK = 2
 SPILL = 4
 STARVED = 8
+OVERLOAD = 16
 
 #: a zero-commit run of at least this many ticks, with abort/admission
 #: churn inside it, is flagged as live-lock
@@ -203,7 +207,7 @@ def build_report(summary: dict, timeline: dict | None = None,
 def watchdog(summary: dict, timeline: dict | None = None,
              precomputed_reconcile: list | None = None) -> tuple:
     """(findings, exit_bitmask).  Each finding is ``(FLAG_NAME, message)``;
-    the bitmask ORs RECONCILE/LIVELOCK/SPILL/STARVED."""
+    the bitmask ORs RECONCILE/LIVELOCK/SPILL/STARVED/OVERLOAD."""
     findings = []
     code = 0
 
@@ -256,6 +260,25 @@ def watchdog(summary: dict, timeline: dict | None = None,
             ("SPILL", f"compaction spill storm: spill_aborts={spills} "
                       f"overflow={ovf} vs {commits + aborts} outcomes"))
         code |= SPILL
+
+    # open-system overload: the run ended with more admission backlog
+    # than one measured tick of service can drain.  A recovered flash
+    # crowd (deneva_tpu/traffic/ rate-step schedule back below the knee)
+    # ends with queue_len == 0 and does NOT fire; a sustained
+    # over-offered rate leaves the queue growing and does.  Keys are
+    # present only for Config.arrival runs — closed-loop summaries skip
+    # this check entirely.
+    if "queue_len" in summary:
+        qlen = int(summary["queue_len"])
+        ticks = max(int(summary.get("measured_ticks", 0)), 1)
+        service = max(1, commits // ticks)
+        if qlen > service:
+            findings.append(
+                ("OVERLOAD", f"admission backlog at run end: "
+                             f"queue_len={qlen} > {service} "
+                             f"commits/tick (peak={int(summary.get('queue_peak', 0))}, "
+                             f"arrivals={int(summary.get('arrival_cnt', 0))})"))
+            code |= OVERLOAD
     return findings, code
 
 

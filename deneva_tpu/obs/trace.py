@@ -80,6 +80,11 @@ def init_trace(cfg, lat_samples: int) -> dict:
         out["arr_reason_trace"] = jnp.zeros((cfg.trace_ticks, n),
                                             jnp.int32)
         out["arr_reason_tick"] = jnp.zeros(n, jnp.int32)
+    if cfg.arrival is not None:
+        # admission-queue depth companion ring (one column), same
+        # SEPARATE-array discipline as the reason ring: TRACE_COLUMNS —
+        # and every consumer of it — is unchanged for closed-loop runs
+        out["arr_queue_trace"] = jnp.zeros(cfg.trace_ticks, jnp.int32)
     return out
 
 
@@ -120,6 +125,21 @@ def record_reasons(stats: dict, t) -> dict:
                 stats["arr_reason_tick"], unique_indices=True)}
 
 
+def record_queue(stats: dict, t) -> dict:
+    """Accumulate the end-of-admission backlog (``queue_len``,
+    deneva_tpu/traffic/) into the queue-depth ring.  Same
+    wrap-and-accumulate discipline as :func:`record_tick`, so the ring
+    sum equals the whole run's backlog integral (the UNGATED
+    ``lat_work_queue_time`` when ``warmup_ticks == 0``); no-op unless
+    the run traces with an arrival model."""
+    if "arr_queue_trace" not in stats:
+        return stats
+    buf = stats["arr_queue_trace"]
+    return {**stats,
+            "arr_queue_trace": buf.at[t % buf.shape[0]].add(
+                stats["queue_len"], unique_indices=True)}
+
+
 def _buffer(state_or_stats) -> np.ndarray:
     stats = getattr(state_or_stats, "stats", state_or_stats)
     assert "arr_trace" in stats, "run with Config.trace_ticks > 0"
@@ -131,6 +151,13 @@ def _reason_buffer(state_or_stats) -> np.ndarray | None:
     if "arr_reason_trace" not in stats:
         return None
     return np.asarray(stats["arr_reason_trace"])
+
+
+def _queue_buffer(state_or_stats) -> np.ndarray | None:
+    stats = getattr(state_or_stats, "stats", state_or_stats)
+    if "arr_queue_trace" not in stats:
+        return None
+    return np.asarray(stats["arr_queue_trace"])
 
 
 def _reason_names() -> tuple:
@@ -146,19 +173,25 @@ def timeline(state_or_stats, per_shard: bool = False) -> dict:
     series per registered reason code."""
     a = _buffer(state_or_stats)
     r = _reason_buffer(state_or_stats)
+    q = _queue_buffer(state_or_stats)
     if a.ndim == 3 and not per_shard:
         a = a.sum(axis=0)
         r = r.sum(axis=0) if r is not None else None
+        q = q.sum(axis=0) if q is not None else None
     if a.ndim == 3:
         out = {name: a[:, :, i] for i, name in enumerate(TRACE_COLUMNS)}
         if r is not None:
             out.update({name: r[:, :, i]
                         for i, name in enumerate(_reason_names())})
+        if q is not None:
+            out["queue_depth"] = q
         return out
     out = {name: a[:, i] for i, name in enumerate(TRACE_COLUMNS)}
     if r is not None:
         out.update({name: r[:, i]
                     for i, name in enumerate(_reason_names())})
+    if q is not None:
+        out["queue_depth"] = q
     return out
 
 
@@ -174,6 +207,11 @@ def totals(state_or_stats) -> dict:
         rflat = r.reshape(-1, r.shape[-1]).sum(axis=0)
         out.update({name: int(rflat[i])
                     for i, name in enumerate(_reason_names())})
+    q = _queue_buffer(state_or_stats)
+    if q is not None:
+        # backlog integral (txn-ticks queued behind admission); equals
+        # the ungated lat_work_queue_time when warmup_ticks == 0
+        out["queue_depth"] = int(q.sum())
     return out
 
 
@@ -196,6 +234,10 @@ def to_chrome_trace(state_or_stats, path: str, n_ticks: int | None = None,
     rshards = None
     if rbuf is not None:
         rshards = rbuf[None] if rbuf.ndim == 2 else rbuf
+    qbuf = _queue_buffer(state_or_stats)
+    qshards = None
+    if qbuf is not None:
+        qshards = qbuf[None] if qbuf.ndim == 1 else qbuf
     rnames = _reason_names()
     N, T, _ = shards.shape
     if n_ticks is not None:
@@ -228,6 +270,13 @@ def to_chrome_trace(state_or_stats, path: str, n_ticks: int | None = None,
                                "ts": ts, "pid": node,
                                "args": {c: int(rshards[node][t, i])
                                         for i, c in enumerate(rnames)}})
+            if qshards is not None:
+                # 6th counter track (same conditional discipline): the
+                # admission-queue depth of open-system (arrival) runs
+                events.append({"name": "admission queue", "ph": "C",
+                               "ts": ts, "pid": node,
+                               "args": {"queue_depth":
+                                        int(qshards[node][t])}})
     xentries = []
     if xmeter:
         # 5th counter track, present only when an xmeter snapshot is
@@ -249,6 +298,8 @@ def to_chrome_trace(state_or_stats, path: str, n_ticks: int | None = None,
                         "tick_us": tick_us, "shards": N, "ticks": T}}
     if rshards is not None:
         doc["metadata"]["reason_columns"] = list(rnames)
+    if qshards is not None:
+        doc["metadata"]["queue_track"] = True
     if xentries:
         doc["metadata"]["xmeter_entries"] = xentries
     with open(path, "w") as f:

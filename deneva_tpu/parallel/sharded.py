@@ -50,6 +50,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from deneva_tpu.compat import shard_map
 
 from deneva_tpu import cc as cc_registry
+from deneva_tpu import traffic
 from deneva_tpu import workloads as wl_registry
 from deneva_tpu.cc import base as cc_base
 from deneva_tpu.config import Config, TPCC
@@ -184,11 +185,30 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
             acap = min(acap, cfg.epoch_size)
             gate = gate + jnp.sum(expire.astype(jnp.int32))
         acap = min(acap, cfg.batch_size, Q)
-        free = free & (gate < acap)
+        admit_ok = gate < acap
+        if cfg.arrival is not None:
+            # open-system backpressure (deneva_tpu/traffic/): every node
+            # draws its own arrival stream — the carried key is
+            # node-replicated, fold_in(node_id) decorrelates the tick
+            # subkeys — and AP replica nodes draw zero (their free mask
+            # is cleared below, so a nonzero draw would strand backlog).
+            # ``acap`` stays a Python constant (pool_admit block-fetches
+            # jnp.arange(acap)); the traced rate only moves the
+            # ``frank < avail`` prefix mask, so the jaxpr is
+            # rate-independent — zero recompiles across schedule steps.
+            n_arr, stats = traffic.sample_arrivals(
+                cfg, stats, t, node_id=node_id,
+                active=(node_id < n_parts) if cfg.repl_mode == "ap"
+                else None)
+            avail = stats["queue_len"] + n_arr
+            admit_ok = admit_ok & (frank < avail)
+        free = free & admit_ok
         if cfg.repl_mode == "ap":
             # ISREPLICA (global.h:301): the upper mesh half runs no txns
             free = free & (node_id < n_parts)
         n_free = jnp.sum(free.astype(jnp.int32))
+        if cfg.arrival is not None:
+            stats = traffic.note_admission(stats, avail, n_free, measuring)
 
         from deneva_tpu.engine.scheduler import pool_admit
         keys, is_write, n_req, txn_type, targs, aux, pool_idx = pool_admit(
@@ -855,6 +875,9 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
         stats = track_parts_touched(stats, txn, commit, n_parts, measuring)
         stats = record_commit_latency(stats, commit, t, txn.start_tick,
                                       measuring)
+        stats = traffic.record_family_latency(stats, commit, txn.txn_type,
+                                              t - txn.first_start_tick,
+                                              measuring)
         stats = bump(stats, "unique_txn_abort_cnt",
                      jnp.sum((commit & (txn.restarts > 0)).astype(jnp.int32)),
                      measuring)
@@ -955,6 +978,7 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
                 lock_wait=jnp.sum(wait.astype(jnp.int32)),
                 live_entries=live_delta, compact_ovf=ovf_delta)
             stats = obs_trace.record_reasons(stats, t)
+            stats = obs_trace.record_queue(stats, t)
         if dly:
             # with a real delay model, network time is the per-tick count
             # of txns blocked purely on message transit (integrates to
@@ -1112,7 +1136,9 @@ class ShardedEngine:
                 db=db,
                 data=jnp.zeros(rows_local, jnp.int32),
                 tables=self.workload.init_tables(cfg, part),
-                stats={**_zeros_stats(cfg),
+                stats={**_zeros_stats(
+                           cfg,
+                           n_families=int(self.pool.txn_type.max()) + 1),
                        **{k: jnp.zeros((), jnp.int32)
                           for k in SHARD_STAT_KEYS}},
                 tick=jnp.zeros((), jnp.int32),
@@ -1264,6 +1290,14 @@ class ShardedEngine:
                    else np.zeros(0, np.int32))
         out["ccl_samples"] = tuple(samples.tolist())
         out["ccl_valid"] = samples.shape[0]
+        if "arr_fam_lat" in state.stats:
+            # per-family long-latency percentiles over every node's ring
+            # (family_percentiles concatenates the (N, F, S) valid
+            # prefixes; queue_* counters above are already the psum —
+            # queue_peak is the SUM of per-node peaks, a cluster
+            # backlog-pressure bound, not a max)
+            out.update(traffic.family_percentiles(
+                state.stats["arr_fam_lat"], state.stats["arr_fam_cursor"]))
         if wall_seconds is not None:
             out["tput"] = s["txn_cnt"] / wall_seconds
         if self.xmeter is not None:

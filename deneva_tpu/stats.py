@@ -79,7 +79,11 @@ def reference_summary(s: dict, wall_seconds: float | None = None) -> dict:
         "lat_abort_time": s.get("lat_abort_time", 0.0) * tick_sec,
         "lat_process_time": s.get("lat_process_time", 0.0) * tick_sec,
         "lat_network_time": s.get("lat_network_time", 0.0) * tick_sec,
-        "lat_work_queue_time": 0.0,   # no queueing: every txn runs per tick
+        # work-queue wait: the Little's-law backlog integral of the
+        # open-system arrival plane (deneva_tpu/traffic/ — txn-ticks
+        # queued behind admission).  Closed-loop runs carry no backlog
+        # and the key stays exactly 0.0.
+        "lat_work_queue_time": s.get("lat_work_queue_time", 0.0) * tick_sec,
         "lat_msg_queue_time": 0.0,    # exchanges happen inside the tick
         # CC counters
         "twopl_wait_cnt": s.get("twopl_wait_cnt", 0),
@@ -121,6 +125,19 @@ def reference_summary(s: dict, wall_seconds: float | None = None) -> dict:
     for k in sorted(s):
         if k.startswith(_XMETER_PREFIXES) and k not in out:
             out[k] = s[k]
+    # open-system traffic keys (Config.arrival, deneva_tpu/traffic/):
+    # the arrival/queue conservation counters pass through verbatim and
+    # the per-family famlat* latency percentiles scale with the
+    # timebase (they are tick-valued latencies; the famlat{f}_n sample
+    # counts stay integers).  Present only for arrival runs — the
+    # closed-loop default line stays byte-identical.
+    _TRAFFIC_PREFIXES = ("arrival_", "queue_")
+    for k in sorted(s):
+        if k.startswith(_TRAFFIC_PREFIXES) and k not in out:
+            out[k] = s[k]
+    for k in sorted(s):
+        if k.startswith("famlat") and k not in out:
+            out[k] = s[k] * tick_sec if isinstance(s[k], float) else s[k]
     # reference-name ALIASES for the invented chain counters, so parsers
     # of reference-format summaries (stats.cpp:907 prints case1..6) keep
     # their maat_caseN_cnt fields.  The reference's case2/4/5 fire against

@@ -1,0 +1,11 @@
+"""Open-system traffic engine: device-resident arrival streams feeding
+the txn pool through admission backpressure (see traffic/arrival.py for
+the model catalog and the conservation/no-drop contract)."""
+
+from deneva_tpu.traffic.arrival import (FAM_PCTS, family_percentiles,
+                                        init_arrival, note_admission,
+                                        record_family_latency,
+                                        sample_arrivals)
+
+__all__ = ["FAM_PCTS", "family_percentiles", "init_arrival",
+           "note_admission", "record_family_latency", "sample_arrivals"]

@@ -1,0 +1,183 @@
+"""Open-system arrival streams: the device-resident client plane.
+
+The reference is an OPEN system — dedicated client processes generate
+transactions open-loop (client/client_main.cpp) and the servers absorb
+them through a work queue (client_thread.cpp:70-91 LOAD_MAX/LOAD_RATE) —
+and the VLDB evaluation sweeps offered load to the throughput-vs-latency
+knee.  The rebuild's engine is closed-loop: B slots that refill
+instantly, so overload and queueing are unobservable.  This module
+supplies the missing client plane as a device-resident arrival process:
+
+- ``"poisson"``  seeded Poisson at ``Config.arrival_rate`` txns/tick;
+- ``"mmpp"``     2-state Markov-modulated Poisson (calm/burst regimes,
+                 per-tick switch probabilities) — bursty load;
+- ``"step"``     piecewise-constant rate schedule
+                 (``Config.arrival_schedule``) sampled through Poisson —
+                 flash crowds and rate steps.
+
+Everything is jit-safe per-tick arithmetic: the PRNG key is CARRIED in
+the stats dict (``arr_arrival_key``; the sharded engine decorrelates
+per-node streams by folding ``node_id`` into the tick subkey), the
+schedule is baked as trace constants indexed by the traced tick, and no
+shape depends on data — so a rate step causes ZERO steady-state
+recompiles (the xmeter sentinel enforces this in tests/test_traffic.py).
+
+Arrivals beyond what admission can take (free slots, ``admit_cap``, the
+Calvin epoch gate) queue in a carried backlog counter (``queue_len``).
+The engine NEVER drops:
+
+    ``arrival_cnt == queue_admit_cnt + queue_len``
+
+holds exactly at every tick (conservation — the no-drop proof the tests
+assert).  Backlog integrated over measured ticks is the real
+``lat_work_queue_time`` (Little's law: each queued txn accrues one
+txn-tick of work-queue wait per tick it waits), replacing the hardwired
+zero in deneva_tpu/stats.py.
+
+When ``Config.arrival is None`` (default) no arrays are carried and the
+tick graph is bit-identical to a build without this module — the same
+off-path discipline as obs/trace.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: famlat{f}_p{P} summary percentiles (the open-system SLO view: p50 for
+#: the median user, p95/p99 for the tail the paper's knee methodology
+#: cares about)
+FAM_PCTS = (50, 95, 99)
+
+
+def init_arrival(cfg, n_families: int = 1) -> dict:
+    """Stats-dict entries for the arrival plane; empty when closed-loop
+    (the disabled path carries nothing)."""
+    if cfg.arrival is None:
+        return {}
+    out = {
+        # carried PRNG key (threefry (2,) uint32); arr_-prefixed like
+        # every non-summary array so Engine.summary skips it
+        "arr_arrival_key": jax.random.PRNGKey(cfg.arrival_seed),
+        # conservation triple: generated == admitted + still queued
+        "arrival_cnt": jnp.zeros((), jnp.int32),
+        "queue_admit_cnt": jnp.zeros((), jnp.int32),
+        "queue_len": jnp.zeros((), jnp.int32),
+        "queue_peak": jnp.zeros((), jnp.int32),
+        # Little's-law backlog integral (warmup-gated like its lat_* kin)
+        "lat_work_queue_time": jnp.zeros((), jnp.float32),
+        # per-family LONG-latency sampling rings -> famlat{f}_p50/95/99
+        "arr_fam_lat": jnp.zeros((n_families, cfg.fam_lat_samples),
+                                 jnp.int32),
+        "arr_fam_cursor": jnp.zeros((n_families,), jnp.int32),
+    }
+    if cfg.arrival == "mmpp":
+        out["arr_arrival_phase"] = jnp.zeros((), jnp.int32)  # 0 calm 1 burst
+    return out
+
+
+def _schedule_rate(schedule, t):
+    """Piecewise-constant rate at traced tick t: the LAST schedule point
+    with tick <= t rules (before the first point, its rate applies).
+    The points are baked as trace constants, so rate changes over t are
+    plain data flow — no recompile."""
+    ticks = jnp.asarray([int(p[0]) for p in schedule], jnp.int32)
+    rates = jnp.asarray([float(p[1]) for p in schedule], jnp.float32)
+    idx = jnp.maximum(jnp.sum((t >= ticks).astype(jnp.int32)) - 1, 0)
+    return rates[idx]
+
+
+def sample_arrivals(cfg, stats: dict, t, node_id=None, active=None):
+    """Draw this tick's arrival count (int32 scalar) and advance the
+    carried key/regime; bumps ``arrival_cnt`` (NOT warmup-gated — the
+    conservation identity must hold from tick 0).
+
+    ``node_id`` (sharded engine) folds into the tick subkey so per-node
+    streams decorrelate while the carried key stays node-replicated;
+    ``active`` (bool scalar) zeroes the stream (AP replica nodes receive
+    no client traffic)."""
+    key, k_arr, k_ph = jax.random.split(stats["arr_arrival_key"], 3)
+    if node_id is not None:
+        k_arr = jax.random.fold_in(k_arr, node_id)
+        k_ph = jax.random.fold_in(k_ph, node_id)
+    stats = {**stats, "arr_arrival_key": key}
+    if cfg.arrival == "step":
+        lam = _schedule_rate(cfg.arrival_schedule, t)
+    elif cfg.arrival == "mmpp":
+        phase = stats["arr_arrival_phase"]
+        p_switch = jnp.where(phase == 0,
+                             jnp.float32(cfg.arrival_p_burst),
+                             jnp.float32(cfg.arrival_p_calm))
+        flip = jax.random.uniform(k_ph) < p_switch
+        phase = jnp.where(flip, 1 - phase, phase)
+        lam = jnp.where(phase == 0, jnp.float32(cfg.arrival_rate),
+                        jnp.float32(cfg.arrival_burst_rate))
+        stats = {**stats, "arr_arrival_phase": phase}
+    else:  # "poisson"
+        lam = jnp.float32(cfg.arrival_rate)
+    n_arr = jnp.maximum(jax.random.poisson(k_arr, lam, dtype=jnp.int32), 0)
+    if active is not None:
+        n_arr = jnp.where(active, n_arr, 0)
+    return n_arr, {**stats, "arrival_cnt": stats["arrival_cnt"] + n_arr}
+
+
+def note_admission(stats: dict, avail, n_free, measuring) -> dict:
+    """Post-admission backlog bookkeeping: ``avail`` is backlog + this
+    tick's arrivals, ``n_free`` what admission took.  The counters are
+    NOT warmup-gated (conservation holds from tick 0); only the
+    Little's-law wait integral is, like its lat_* siblings."""
+    qlen = avail - n_free
+    inc = jnp.where(measuring, qlen, 0).astype(jnp.float32)
+    return {**stats,
+            "queue_len": qlen,
+            "queue_admit_cnt": stats["queue_admit_cnt"] + n_free,
+            "queue_peak": jnp.maximum(stats["queue_peak"], qlen),
+            "lat_work_queue_time": stats["lat_work_queue_time"] + inc}
+
+
+def record_family_latency(stats: dict, commit, txn_type, lat,
+                          measuring) -> dict:
+    """Append committing txns' LONG latencies (first start -> commit)
+    to the per-family sampling ring.  Same ring discipline as
+    engine/scheduler.py record_commit_latency: survivors of a sequential
+    append occupy distinct in-ring positions mod S, dead lanes map to
+    DISTINCT out-of-bounds cells (LINT.md scatter rules).  No-op when
+    the arrival plane is off."""
+    if "arr_fam_lat" not in stats:
+        return stats
+    ring, cur = stats["arr_fam_lat"], stats["arr_fam_cursor"]
+    F, S = ring.shape
+    lanes = jnp.arange(commit.shape[0], dtype=jnp.int32)
+    fam = jnp.clip(txn_type, 0, F - 1)
+    take = commit & measuring
+    for f in range(F):           # F is small and static (1/2/8 families)
+        m = take & (fam == f)
+        rank = jnp.cumsum(m.astype(jnp.int32)) - m.astype(jnp.int32)
+        n = jnp.sum(m.astype(jnp.int32))
+        live = m & (rank >= n - S)
+        pos = jnp.where(live, (cur[f] + rank) % S, S + lanes)
+        ring = ring.at[f, pos].set(lat, mode="drop", unique_indices=True)
+        cur = cur.at[f].add(n)
+    return {**stats, "arr_fam_lat": ring, "arr_fam_cursor": cur}
+
+
+def family_percentiles(ring, cursor) -> dict:
+    """``famlat{f}_p{50,95,99}`` + ``famlat{f}_n`` summary keys from the
+    per-family rings.  Accepts single-shard ``(F, S)``/``(F,)`` or
+    node-stacked ``(N, F, S)``/``(N, F)`` arrays (the cluster view
+    concatenates each node's valid prefix, like the ccl ring merge in
+    ShardedEngine.summary)."""
+    ring, cursor = np.asarray(ring), np.asarray(cursor)
+    if ring.ndim == 2:
+        ring, cursor = ring[None], cursor[None]
+    N, F, S = ring.shape
+    out = {}
+    for f in range(F):
+        parts = [ring[i, f, :min(int(cursor[i, f]), S)] for i in range(N)]
+        s = np.concatenate(parts)
+        out[f"famlat{f}_n"] = int(s.shape[0])
+        for p in FAM_PCTS:
+            out[f"famlat{f}_p{p}"] = (float(np.percentile(s, p))
+                                      if s.size else 0.0)
+    return out

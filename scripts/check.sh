@@ -27,7 +27,8 @@ fi
 echo "== obs smoke (waterfall + watchdog) =="
 # one small attributed+traced cell through bench.py's observed path: the
 # exit code ORs reconciliation failures with the obs watchdog bitmask
-# (RECONCILE=1 LIVELOCK=2 SPILL=4 STARVED=8, deneva_tpu/obs/report.py),
+# (RECONCILE=1 LIVELOCK=2 SPILL=4 STARVED=8 OVERLOAD=16,
+# deneva_tpu/obs/report.py),
 # then the report CLI re-derives the same verdict from the run record
 obs_dir=$(mktemp -d)
 env JAX_PLATFORMS=cpu python bench.py --trace --profile --ticks 40 \
@@ -56,6 +57,37 @@ rm -rf "$xm_dir"
 if [ "$xm_rc" -ne 0 ]; then
     echo "xmeter smoke FAILED (sentinel/ledger bitmask rc=$xm_rc)"
     exit "$xm_rc"
+fi
+
+echo "== saturation smoke (open-system knee + OVERLOAD) =="
+# a tiny two-point offered-load sweep (deneva_tpu/traffic/): the
+# sub-knee point must serve >= 95% of arrivals with a clean watchdog,
+# the over-offered point must trip the OVERLOAD bit (16); the emitted
+# knee JSON must carry the schema the regression gate consumes
+sat_dir=$(mktemp -d)
+env JAX_PLATFORMS=cpu python bench.py --offered-load --rates 4,48 \
+    --algs NO_WAIT --ticks 60 --no-history --out-dir "$sat_dir"
+sat_rc=$?
+if [ "$sat_rc" -eq 0 ]; then
+    env JAX_PLATFORMS=cpu python - "$sat_dir/offered_load_sweep.json" <<'PYEOF'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+assert doc["metric"] == "offered_load_knee", doc["metric"]
+for key in ("value", "unit", "offered_load", "knee", "algs", "sweep"):
+    assert key in doc, f"knee JSON missing {key}"
+pts = doc["sweep"]["NO_WAIT"]
+assert doc["knee"]["NO_WAIT"] == pts[0]["offered"], "knee below low point"
+assert pts[0]["served_frac"] >= 0.95 and pts[0]["watchdog"] == 0, pts[0]
+assert pts[-1]["watchdog"] & 16, f"over-offered point missed OVERLOAD: {pts[-1]}"
+print(f"[saturation] knee={doc['knee']['NO_WAIT']} "
+      f"overload point queue_len={pts[-1]['queue_len']}")
+PYEOF
+    sat_rc=$?
+fi
+rm -rf "$sat_dir"
+if [ "$sat_rc" -ne 0 ]; then
+    echo "saturation smoke FAILED (rc=$sat_rc)"
+    exit "$sat_rc"
 fi
 
 echo "== bench regression gate =="

@@ -86,7 +86,8 @@ def test_single_key_writers_serialize():
     assert s["maat_chain_overflow_cnt"] == 0  # 4 validators <= window 8
 
 
-@pytest.mark.parametrize("window", [1, 4])
+@pytest.mark.parametrize("window",
+                         [1, pytest.param(4, marks=pytest.mark.slow)])
 def test_oracle_and_better_than_nowait_commit_rate(window):
     # MaaT should commit at least as much as NO_WAIT under rw-heavy
     # contention (it never aborts on pure rw overlap)

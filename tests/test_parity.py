@@ -23,10 +23,11 @@ CFG = dict(batch_size=256, synth_table_size=1 << 16, req_per_query=10,
 # (tightened round 4: the oracle's joint slot-order ts draws + deferred
 # N-node releases removed most systematic gaps; round 5: the MaaT
 # access-order-aware commit chain brought MAAT under 1% mean — measured
-# +0.0004+-0.0016 at zipf 0.6, +0.0033+-0.0059 at 0.9 with W=64)
+# +0.0004+-0.0016 at zipf 0.6, +0.0033+-0.0059 at 0.9 with W=64 —
+# so MAAT is now held to 1%, same order as the other refined cells)
 THRESH = {
     "NO_WAIT": 0.02, "WAIT_DIE": 0.015, "TIMESTAMP": 0.008, "MVCC": 0.02,
-    "OCC": 0.005, "MAAT": 0.02, "CALVIN": 0.0,
+    "OCC": 0.005, "MAAT": 0.01, "CALVIN": 0.0,
 }
 
 # per-algorithm refinement knobs the published PARITY.md cells use
@@ -128,7 +129,14 @@ def test_mvcc_tail_fold_counter_zero_with_sliced_merge():
     assert int(np.asarray(st.db["mvcc_tail_fold_cnt"])) == 0
 
 
-@pytest.mark.parametrize("alg", ["NO_WAIT", "WAIT_DIE", "MAAT", "CALVIN"])
+# MAAT's access-order chain oracle (round 5) costs ~7x the other
+# plugins per parity cell; the canonical tier-1 MAAT parity guard is
+# test_abort_rate_parity[MAAT] — the workload-variant MAAT cells run
+# with `-m slow` to keep tier-1 inside its 870 s budget.
+_SLOW_MAAT = pytest.param("MAAT", marks=pytest.mark.slow)
+
+
+@pytest.mark.parametrize("alg", ["NO_WAIT", "WAIT_DIE", _SLOW_MAAT, "CALVIN"])
 def test_tpcc_parity(alg):
     """TPC-C pools through the same oracle: divergence at noise level
     (PARITY.md TPC-C table: seed-averaged means <= 0.1%)."""
@@ -149,7 +157,8 @@ PPS_THRESH = {
 }
 
 
-@pytest.mark.parametrize("alg", list(PPS_THRESH))
+@pytest.mark.parametrize("alg", [_SLOW_MAAT if a == "MAAT" else a
+                                 for a in PPS_THRESH])
 def test_pps_parity(alg):
     """PPS pools (8-type mix, USES/SUPPLIES chain walks) through the same
     oracle — the workload's long read chains and PART_AMOUNT writes."""
@@ -177,7 +186,7 @@ def test_calvin_pps_recon_parity():
     assert r["tput_ratio"] == 1.0, r
 
 
-@pytest.mark.parametrize("alg", ["NO_WAIT", "MAAT", "CALVIN"])
+@pytest.mark.parametrize("alg", ["NO_WAIT", _SLOW_MAAT, "CALVIN"])
 def test_tpcc_rbk_parity(alg):
     """TPC-C with NewOrder rollbacks enabled (tpcc_rbk_perc > 0): the
     oracle replays the user-abort path (release like an abort, free the

@@ -10,13 +10,14 @@ from deneva_tpu.parallel.sharded import ShardedEngine
 from deneva_tpu.engine.scheduler import Engine
 
 # These were collection errors at the seed (pre shard_map compat fix);
-# the slower four exceed the tier-1 time budget -- run with `-m slow`.
+# the slower five exceed the tier-1 time budget -- run with `-m slow`
+# (MAAT's commit-exchange forward validation is the costliest compile).
 ALGS = ["NO_WAIT",
         pytest.param("WAIT_DIE", marks=pytest.mark.slow),
         pytest.param("TIMESTAMP", marks=pytest.mark.slow),
         pytest.param("MVCC", marks=pytest.mark.slow),
         pytest.param("OCC", marks=pytest.mark.slow),
-        "MAAT"]
+        pytest.param("MAAT", marks=pytest.mark.slow)]
 
 
 def shard_cfg(n, **kw):

@@ -106,6 +106,41 @@ def test_prog_line_tag():
     assert stats_mod.parse_summary("no tag here k=1") == {}
 
 
+def test_traffic_keys_round_trip_exactly():
+    """Open-system runs (Config.arrival, deneva_tpu/traffic/) put the
+    arrival/queue conservation counters and per-family percentile keys
+    on the [summary] line; they round-trip through the parser port with
+    EXACT key names, and the closed-loop line carries none of them."""
+    eng, st = run_engine(arrival="poisson", arrival_rate=6.0)
+    line = eng.summary_line(st, wall_seconds=1.0)
+    parsed = stats_mod.parse_summary(line)
+    for key in ("arrival_cnt", "queue_admit_cnt", "queue_len",
+                "queue_peak", "lat_work_queue_time",
+                "famlat0_n", "famlat0_p50", "famlat0_p95", "famlat0_p99"):
+        assert key in parsed, key
+    s = eng.summary(st)
+    assert parsed["arrival_cnt"] == s["arrival_cnt"]
+    assert parsed["queue_admit_cnt"] == s["queue_admit_cnt"]
+    # the no-drop conservation identity survives the round trip
+    assert parsed["arrival_cnt"] == parsed["queue_admit_cnt"] \
+        + parsed["queue_len"]
+    # timebase: the famlat percentiles are tick-valued latencies and
+    # scale with wall seconds like ccl*; the sample COUNT stays integral
+    d1 = stats_mod.reference_summary(s)
+    d2 = stats_mod.reference_summary(s, wall_seconds=s["measured_ticks"]
+                                     * 2.0)
+    assert abs(d2["famlat0_p50"] - 2.0 * d1["famlat0_p50"]) < 1e-6
+    assert d2["famlat0_n"] == d1["famlat0_n"]
+    assert d2["arrival_cnt"] == d1["arrival_cnt"]   # counters unscaled
+
+    # closed loop: no traffic keys at all, queue time exactly zero
+    eng0, st0 = run_engine()
+    p0 = stats_mod.parse_summary(eng0.summary_line(st0, wall_seconds=1.0))
+    assert p0["lat_work_queue_time"] == 0.0
+    assert not any(k.startswith(("arrival_", "queue_", "famlat"))
+                   for k in p0)
+
+
 def test_cc_case_counter_families():
     """The per-algorithm families (reference maat_case1/3 + this build's
     chain counters, occ check aborts) ride the [summary] line VERBATIM

@@ -1,0 +1,222 @@
+"""Open-system traffic engine tests (deneva_tpu/traffic/): arrival-stream
+determinism, admission-backpressure conservation, flash-crowd recovery,
+the OVERLOAD watchdog bit, the queue-depth trace plane and the off-path
+byte-identity contract (``Config.arrival=None`` must leave the engine —
+state carry, stats keys, [summary] line — untouched).
+
+Conservation contract under test (traffic/arrival.py):
+``arrival_cnt == queue_admit_cnt + queue_len`` at every boundary — txns
+are shed by QUEUEING, never dropped.
+"""
+
+import numpy as np
+import pytest
+
+from deneva_tpu import stats as stats_mod
+from deneva_tpu.config import Config
+from deneva_tpu.engine.scheduler import Engine
+from deneva_tpu.obs import report as obs_report
+from deneva_tpu.obs import trace as obs_trace
+
+BASE = dict(cc_alg="NO_WAIT", batch_size=64, synth_table_size=1 << 10,
+            req_per_query=4, zipf_theta=0.6, query_pool_size=1 << 10,
+            warmup_ticks=0)
+
+TRAFFIC_KEYS = ("arrival_cnt", "queue_admit_cnt", "queue_len", "queue_peak")
+
+
+def summarize(cfg, n_ticks=40, compiled=False):
+    eng = Engine(cfg)
+    st = (eng.run_compiled(n_ticks) if compiled else eng.run(n_ticks))
+    return eng, st, eng.summary(st)
+
+
+def test_same_seed_bit_identical_across_runs_and_scan():
+    cfg = Config(arrival="poisson", arrival_rate=6.0, **BASE)
+    _, _, s1 = summarize(cfg)
+    _, _, s2 = summarize(cfg)
+    _, _, s3 = summarize(cfg, compiled=True)   # fori_loop scan stepping
+    for k in TRAFFIC_KEYS + ("txn_cnt", "lat_work_queue_time"):
+        assert s1[k] == s2[k], (k, s1[k], s2[k])
+        assert s1[k] == s3[k], ("scan vs per-tick", k, s1[k], s3[k])
+    # a different arrival seed draws a different stream
+    _, _, s4 = summarize(cfg.replace(arrival_seed=99))
+    assert s4["arrival_cnt"] != s1["arrival_cnt"]
+
+
+@pytest.mark.parametrize("model,kw", [
+    ("poisson", dict(arrival_rate=6.0)),
+    ("mmpp", dict(arrival_rate=3.0, arrival_burst_rate=30.0)),
+    ("step", dict(arrival_schedule=((0, 2.0), (10, 20.0), (25, 4.0)))),
+])
+def test_conservation_no_drop(model, kw):
+    cfg = Config(arrival=model, **kw, **BASE)
+    _, _, s = summarize(cfg)
+    assert s["arrival_cnt"] > 0
+    assert s["arrival_cnt"] == s["queue_admit_cnt"] + s["queue_len"], s
+    assert s["queue_peak"] >= s["queue_len"]
+
+
+def test_flash_crowd_drains_to_empty_queue():
+    cfg = Config(arrival="step",
+                 arrival_schedule=((0, 3.0), (15, 100.0), (25, 1.0)),
+                 **{**BASE, "zipf_theta": 0.1, "req_per_query": 2})
+    _, _, s = summarize(cfg, n_ticks=200)
+    assert s["queue_peak"] > 0, "flash crowd never queued"
+    assert s["queue_len"] == 0, f"backlog not drained: {s['queue_len']}"
+    assert s["arrival_cnt"] == s["queue_admit_cnt"]
+    # drained run must NOT trip the overload watchdog
+    _, code = obs_report.watchdog(s)
+    assert not (code & obs_report.OVERLOAD), code
+
+
+def test_overload_bit_fires_and_recovers():
+    # sustained over-offered rate: backlog at run end trips OVERLOAD
+    over = Config(arrival="poisson", arrival_rate=200.0, **BASE)
+    _, _, s = summarize(over, n_ticks=40)
+    assert s["queue_len"] > 0
+    findings, code = obs_report.watchdog(s)
+    assert code & obs_report.OVERLOAD, (code, findings)
+    assert any(f[0] == "OVERLOAD" for f in findings)
+    # under-offered: clean
+    _, _, s2 = summarize(Config(arrival="poisson", arrival_rate=2.0,
+                                **BASE), n_ticks=40)
+    _, c2 = obs_report.watchdog(s2)
+    assert not (c2 & obs_report.OVERLOAD)
+    # closed-loop summaries never reach the check at all
+    _, c3 = obs_report.watchdog({"txn_cnt": 10, "measured_ticks": 5})
+    assert not (c3 & obs_report.OVERLOAD)
+
+
+def test_work_queue_time_nonzero_open_zero_closed():
+    over = Config(arrival="poisson", arrival_rate=50.0, **BASE)
+    _, _, s = summarize(over)
+    assert s["lat_work_queue_time"] > 0
+    d = stats_mod.reference_summary(s)
+    assert d["lat_work_queue_time"] > 0
+    # closed loop: the key is absent from the engine summary and exactly
+    # 0.0 on the reference line (the pre-traffic hardwired contract)
+    _, _, s0 = summarize(Config(**BASE))
+    assert "lat_work_queue_time" not in s0
+    d0 = stats_mod.reference_summary(s0)
+    assert d0["lat_work_queue_time"] == 0.0
+
+
+@pytest.mark.parametrize(
+    "alg", ["NO_WAIT", "WAIT_DIE", "TIMESTAMP", "MVCC", "OCC", "MAAT",
+            "CALVIN"])
+def test_closed_loop_carries_no_traffic_state(alg):
+    """arrival=None (the default) must add ZERO arrays to the state carry
+    and ZERO keys to the [summary] line for every CC plugin — the
+    off-path byte-identity discipline."""
+    cfg = Config(**{**BASE, "cc_alg": alg, "batch_size": 32,
+                    "synth_table_size": 256, "req_per_query": 2})
+    eng = Engine(cfg)
+    st = eng.run(6)
+    carried = set(st.stats)
+    assert not any(k.startswith(("arr_arrival", "arr_fam")) for k in carried)
+    assert not any(k in carried for k in TRAFFIC_KEYS)
+    line = eng.summary_line(st)
+    assert "lat_work_queue_time=0.000000" in line
+    parsed = stats_mod.parse_summary(line)
+    assert not any(k.startswith(("arrival_", "queue_", "famlat"))
+                   for k in parsed)
+
+
+def test_family_latency_rings_multi_family():
+    # TPC-C carries two live txn families (workloads/tpcc.py program
+    # ids: Payment=1, NewOrder=2; id 0 is unused): each gets its own
+    # percentile ring and [summary] keys, and the empty family reports
+    # zero samples rather than poisoning the percentiles
+    cfg = Config(workload="TPCC", cc_alg="NO_WAIT", batch_size=64,
+                 num_wh=4, cust_per_dist=1000, max_items=128,
+                 query_pool_size=1 << 10, warmup_ticks=0,
+                 synth_table_size=8, arrival="poisson", arrival_rate=8.0)
+    eng, st, s = summarize(cfg, n_ticks=50)
+    assert s["famlat1_n"] > 0 and s["famlat2_n"] > 0
+    assert s["famlat0_n"] == 0 and s["famlat0_p99"] == 0.0
+    assert s["famlat0_n"] + s["famlat1_n"] + s["famlat2_n"] == s["txn_cnt"]
+    for f in (1, 2):
+        assert s[f"famlat{f}_p50"] <= s[f"famlat{f}_p95"] \
+            <= s[f"famlat{f}_p99"]
+    line = eng.summary_line(st)
+    parsed = stats_mod.parse_summary(line)
+    for k in ("famlat0_n", "famlat1_p50", "famlat2_p99", "famlat2_n"):
+        assert k in parsed, k
+
+
+def test_queue_depth_trace_and_chrome_track(tmp_path):
+    import json
+    cfg = Config(arrival="poisson", arrival_rate=40.0, trace_ticks=64,
+                 **BASE)
+    eng, st, s = summarize(cfg, n_ticks=40)
+    tl = obs_trace.timeline(st)
+    assert "queue_depth" in tl
+    # ring sum == the UNGATED backlog integral (warmup_ticks == 0 here,
+    # so it equals the measured lat_work_queue_time integral exactly)
+    assert obs_trace.totals(st)["queue_depth"] == \
+        int(s["lat_work_queue_time"])
+    p = tmp_path / "tr.json"
+    obs_trace.to_chrome_trace(st, str(p))
+    doc = json.loads(p.read_text())
+    assert doc["metadata"].get("queue_track") is True
+    assert any(ev.get("name") == "admission queue"
+               for ev in doc["traceEvents"])
+    # closed loop: no queue series, no counter track, no metadata flag
+    cfg0 = Config(trace_ticks=64, **BASE)
+    eng0 = Engine(cfg0)
+    st0 = eng0.run(10)
+    assert "queue_depth" not in obs_trace.timeline(st0)
+    p0 = tmp_path / "tr0.json"
+    obs_trace.to_chrome_trace(st0, str(p0))
+    assert "queue_track" not in json.loads(p0.read_text())["metadata"]
+
+
+def test_zero_steady_recompiles_across_rate_step():
+    cfg = Config(arrival="step",
+                 arrival_schedule=((0, 2.0), (15, 40.0), (30, 2.0)),
+                 xmeter=True, **BASE)
+    eng = Engine(cfg)
+    st = eng.run(10)
+    eng.xmeter.mark_warm()
+    eng.run(30, state=st)          # crosses both rate steps post-warm
+    assert eng.xmeter.steady_violations() == []
+
+
+def test_arrival_config_validation():
+    with pytest.raises(AssertionError):
+        Config(arrival="bogus", **BASE)
+    with pytest.raises(AssertionError):
+        Config(arrival="poisson", **BASE)            # rate required
+    with pytest.raises(AssertionError):
+        Config(arrival="step", **BASE)               # schedule required
+    with pytest.raises(AssertionError):
+        Config(arrival="step",
+               arrival_schedule=((10, 2.0), (5, 4.0)), **BASE)  # ordering
+    with pytest.raises(AssertionError):
+        Config(arrival="mmpp", arrival_rate=2.0, **BASE)  # burst required
+
+
+@pytest.mark.slow  # sharded compile cost exceeds the tier-1 budget
+def test_sharded_arrival_conservation_and_decorrelation():
+    from deneva_tpu.parallel.sharded import ShardedEngine
+    cfg = Config(cc_alg="NO_WAIT", node_cnt=4, part_cnt=4, batch_size=32,
+                 synth_table_size=1 << 10, req_per_query=2, zipf_theta=0.5,
+                 query_pool_size=1 << 10, warmup_ticks=0,
+                 arrival="poisson", arrival_rate=4.0)
+    eng = ShardedEngine(cfg)
+    st = eng.run(30)
+    s = eng.summary(st)
+    # cluster-wide conservation (psum'd counters)
+    assert s["arrival_cnt"] == s["queue_admit_cnt"] + s["queue_len"]
+    # per-node conservation AND decorrelated per-node streams
+    arr = np.asarray(st.stats["arrival_cnt"])
+    adm = np.asarray(st.stats["queue_admit_cnt"])
+    qln = np.asarray(st.stats["queue_len"])
+    assert (arr == adm + qln).all()
+    assert len(set(arr.tolist())) > 1, "per-node streams correlated"
+    assert s["famlat0_n"] == s["txn_cnt"]
+    line = eng.summary_line(st)
+    parsed = stats_mod.parse_summary(line)
+    for k in TRAFFIC_KEYS + ("famlat0_p99",):
+        assert k in parsed, k
