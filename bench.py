@@ -303,6 +303,94 @@ _ALGS = ("NO_WAIT", "WAIT_DIE", "TIMESTAMP", "MVCC", "OCC", "MAAT",
          "CALVIN")
 
 
+def run_flight(args, out_dir: str = "results", history: bool = True) -> int:
+    """--flight: transaction flight recorder sweep (obs/flight.py).
+
+    Runs each CC algorithm's small observed cell with the per-txn
+    lifecycle recorder in FULL-SAMPLING mode (every completed txn keeps
+    its span), then:
+
+    - checks the exactness contract: summed span phases must reconcile
+      against the ``lat_*`` integrals and the event histogram against
+      the ``abort_*_cnt`` taxonomy counters (any mismatch fails the run
+      — the recorder is an accounting identity, not an estimate);
+    - prints the ``[tail]`` attribution (obs/report.py): which phase,
+      abort reasons and keys dominate the p99-and-above cohort;
+    - writes one run record per algorithm with the flight snapshot under
+      the top-level ``"flight"`` key (``python -m deneva_tpu.obs.export
+      results/run_*.json`` merges them into one Perfetto timeline);
+    - appends a ``flight_tail_attribution`` record to the bench history:
+      per-alg p99 latency + phase attribution.  The cells carry no
+      ``commits_per_tick``, so obs/regress.py treats them as non-gating
+      metadata (its per-alg gate skips cells without that field).
+
+    Exit code: 0 clean, 1 on any reconciliation mismatch."""
+    from deneva_tpu.obs import flight as obs_flight
+    from deneva_tpu.obs import report as obs_report
+    alg_list = (list(_ALGS) if args.algs == "all"
+                else [a.strip().upper() for a in args.algs.split(",") if a])
+    code = 0
+    algs_hist = {}
+    rec_paths = []
+    for alg in alg_list:
+        cfg = Config(cc_alg=alg, flight=True, abort_attribution=True,
+                     flight_samples=1 << 15, trace_ticks=args.ticks,
+                     **OBS_KW)
+        eng = Engine(cfg)
+        t0 = time.perf_counter()
+        state = eng.run(args.ticks)
+        wall = time.perf_counter() - t0
+        summary = eng.summary(state, wall)
+        snap = obs_flight.snapshot(state)
+        bad = obs_flight.reconcile(snap, summary)
+        for what, got, want in bad:
+            print(f"[flight] {alg} RECONCILE MISMATCH {what}: "
+                  f"got={got} want={want}")
+            code = 1
+        tail = obs_flight.tail_attribution(snap, topk=5)
+        print(f"[flight] {alg}: {snap['span_cnt']} spans, "
+              f"{snap['ev_cnt']} abort events, "
+              f"reconcile {'MISMATCH' if bad else 'exact'}")
+        rep = obs_report.build_report(
+            summary, timeline=obs_trace.timeline(state), flight=snap)
+        print(obs_report.render_text(rep))
+        code |= rep["watchdog"]["exit_code"]
+        rec = obs_profiler.run_record(
+            cfg, summary, timeline=obs_trace.timeline(state),
+            extra={"wall_seconds": wall, "flight": snap, "tail": tail})
+        rec_paths.append(obs_profiler.write_run_record(
+            rec, out_dir=out_dir,
+            name=f"run_flight_{alg.lower()}.json"))
+        cell = {"p99_ticks": tail.get("p_ticks", 0.0),
+                "max_ticks": tail.get("max_ticks", 0),
+                "avg_restarts_at_tail": round(
+                    tail.get("avg_restarts", 0.0), 2)}
+        if tail.get("cohort"):
+            cell["dominant_phase"] = tail["dominant_phase"]
+            cell["phase_share"] = {k: round(v, 4)
+                                   for k, v in tail["phase_share"].items()}
+        algs_hist[alg] = cell
+    doc = {
+        "metric": "flight_tail_attribution",
+        "value": algs_hist.get(alg_list[0], {}).get("p99_ticks", 0.0),
+        "unit": "p99_latency_ticks",
+        "ticks": args.ticks,
+        "algs": algs_hist,
+        "note": "per-alg p99 tail attribution from full-sampling flight "
+                "spans on the small observed cell (OBS_KW); cells carry "
+                "no commits_per_tick, so the regress gate skips them",
+    }
+    print(json.dumps(doc))
+    print(f"[flight] run records: {' '.join(rec_paths)}")
+    print(f"[flight] merge: python -m deneva_tpu.obs.export "
+          f"{' '.join(rec_paths)} -o {out_dir}/flight_trace.json")
+    if history:
+        _append_history(doc, Config(cc_alg=alg_list[0], flight=True,
+                                    abort_attribution=True, **OBS_KW),
+                        out_dir)
+    return code
+
+
 def _git_commit() -> str | None:
     try:
         out = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
@@ -496,6 +584,12 @@ def _cli():
     p.add_argument("--algs", default="all",
                    help="comma-separated CC algorithms for "
                         "--offered-load (default: all seven)")
+    p.add_argument("--flight", action="store_true",
+                   help="transaction flight recorder sweep: per-alg "
+                        "full-sampling lifecycle spans, exact phase/"
+                        "abort reconciliation, [tail] p99 attribution, "
+                        "per-alg run records for obs.export (exit 1 on "
+                        "any reconcile mismatch)")
     p.add_argument("--xmeter", action="store_true",
                    help="compile & memory observatory smoke: recompile "
                         "sentinel + ledger reconcile + roofline "
@@ -514,6 +608,9 @@ if __name__ == "__main__":
     if _args.offered_load:
         raise SystemExit(run_offered_load(_args, out_dir=_args.out_dir,
                                           history=not _args.no_history))
+    if _args.flight:
+        raise SystemExit(run_flight(_args, out_dir=_args.out_dir,
+                                    history=not _args.no_history))
     if _args.xmeter:
         raise SystemExit(run_xmeter(_args))
     if _args.trace or _args.profile or _args.prog_interval:

@@ -319,6 +319,26 @@ class Config:
     #: observatory.
     abort_attribution: bool = False
 
+    #: transaction flight recorder (deneva_tpu/obs/flight.py): when True
+    #: the engine carries a per-slot open-span plane (admission tick,
+    #: first-acquire tick, per-phase tick accumulators mirroring the
+    #: lat_* vocabulary) plus two keep-last sampling rings — completed
+    #: txn spans and per-restart abort events — harvested at EXACTLY the
+    #: sites that bump the aggregate counters, so in full-sampling mode
+    #: (``flight_samples`` >= every completion, ring never wraps) the
+    #: summed span phases reconcile EXACTLY against the lat_* integrals
+    #: and the event histogram against the abort_* taxonomy.  Host side:
+    #: Perfetto span/flow export and the [tail] p99 attribution section
+    #: of obs/report.py.  Requires ``abort_attribution`` (restart events
+    #: carry reason codes).  Off by default — zero extra device arrays
+    #: and a byte-identical [summary] line.
+    flight: bool = False
+    #: completed-span ring depth (keep-last window; the event ring is
+    #: 4x this).  Size it >= expected completions for the exact
+    #: full-sampling reconciliation; smaller keeps a p99-biased recent
+    #: window (the StatsArr analog).
+    flight_samples: int = 1 << 12
+
     #: contention heatmap: hashed per-key conflict histogram bin count
     #: (power of two; 0 = off).  Every WAIT/ABORT decision at a txn's
     #: failing access adds 1 to bin knuth_hash(key) — commutative
@@ -406,6 +426,13 @@ class Config:
                 assert 0.0 <= self.arrival_p_burst <= 1.0
                 assert 0.0 <= self.arrival_p_calm <= 1.0
             assert self.fam_lat_samples > 0
+        if self.flight:
+            # restart events are tagged with registered reason codes and
+            # the host-side reconciliation joins them against the
+            # abort_* taxonomy — the recorder is meaningless without it
+            assert self.abort_attribution, \
+                "flight recorder requires abort_attribution"
+            assert self.flight_samples > 0
         # the conflict histogram hashes with a multiplicative shift, so
         # the bin count must be a power of two (obs: engine heatmap)
         assert self.heatmap_bins >= 0 and \

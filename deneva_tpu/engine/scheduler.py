@@ -34,6 +34,7 @@ from deneva_tpu import workloads as wl_registry
 from deneva_tpu.cc import base as cc_base
 from deneva_tpu.config import Config
 from deneva_tpu import traffic
+from deneva_tpu.obs import flight as obs_flight
 from deneva_tpu.obs import trace as obs_trace
 from deneva_tpu.obs.prog import ProgressEmitter
 from deneva_tpu.obs.profiler import PhaseProfiler
@@ -131,6 +132,10 @@ def _zeros_stats(cfg: Config | None = None,
         s["arr_last_abort_reason"] = jnp.zeros(cfg.batch_size, jnp.int32)
         s["arr_last_abort_key"] = jnp.full(cfg.batch_size, NULL_KEY,
                                            jnp.int32)
+    if cfg is not None and cfg.flight:
+        # transaction flight recorder (obs/flight.py): per-slot open-span
+        # columns + completed-span / abort-event keep-last rings
+        s.update(obs_flight.init_flight(cfg))
     if cfg is not None and cfg.heatmap_bins > 0:
         # contention heatmap (Config.heatmap_bins): hashed per-key
         # conflict histogram + a representative key per bin, per-partition
@@ -261,13 +266,16 @@ def _reason_hist(code_b, mask_b):
 
 
 def note_aborts(cfg: Config, stats: dict, code_b, mask_b,
-                measuring) -> dict:
+                measuring, t=None, key_b=None) -> dict:
     """Bump the per-reason abort counters (and the tick's reason-trace
     accumulator, which is NOT warmup-gated) for one abort-event
     population.  Called at EXACTLY the sites that bump the aggregate
     counters (total_txn_abort_cnt / vabort_cnt / user_abort_cnt), with
     the same masks, so the taxonomy reconciles exactly against them.
-    Shared by both engines."""
+    With the flight recorder on, ``t``/``key_b`` additionally append one
+    row per masked lane to its abort-event ring — event sites == counter
+    sites, the host-side histogram identity of obs/flight.py.  Shared by
+    both engines."""
     if not cfg.abort_attribution:
         return stats
     hist = _reason_hist(code_b, mask_b)
@@ -276,6 +284,8 @@ def note_aborts(cfg: Config, stats: dict, code_b, mask_b,
     if "arr_reason_tick" in stats:
         stats = {**stats,
                  "arr_reason_tick": stats["arr_reason_tick"] + hist}
+    if t is not None:
+        stats = obs_flight.record_events(stats, code_b, mask_b, t, key_b)
     return stats
 
 
@@ -507,7 +517,12 @@ def make_tick(cfg: Config, plugin, pool_dev: dict, workload=None):
             admit_ok = admit_ok & (frank < avail)
         free = free & admit_ok
         n_free = jnp.sum(free.astype(jnp.int32))
+        qwait = None
         if cfg.arrival is not None:
+            # flight recorder: the admitted lanes' client wait, gathered
+            # from the arrival-tick FIFO ring BEFORE note_admission moves
+            # the queue head (zeros when the recorder is off)
+            qwait = traffic.admitted_wait(stats, free, frank, t)
             stats = traffic.note_admission(stats, avail, n_free, measuring)
 
         keys, is_write, n_req, txn_type, targs, aux, pool_idx = pool_admit(
@@ -528,6 +543,7 @@ def make_tick(cfg: Config, plugin, pool_dev: dict, workload=None):
         start_tick = jnp.where(free, t, start_tick)
         first_start_tick = jnp.where(free, t, txn.first_start_tick)
         stats = bump(stats, "local_txn_start_cnt", n_free, measuring)
+        stats = obs_flight.note_admit(stats, free, t, qwait)
 
         backoff_until = txn.backoff_until
         if plugin.epoch_admission and workload.recon_types:
@@ -655,13 +671,16 @@ def make_tick(cfg: Config, plugin, pool_dev: dict, workload=None):
             # above (vabort_cnt / user_abort_cnt), same masks
             stats = note_aborts(cfg, stats,
                                 jnp.full((txn.B,), vabort_code, jnp.int32),
-                                vabort, measuring)
+                                vabort, measuring, t=t)
             stats = note_aborts(cfg, stats,
                                 jnp.full((txn.B,), ua_code, jnp.int32),
-                                ua, measuring)
+                                ua, measuring, t=t)
             stats = note_last_abort(stats, vabort | ua,
                                     jnp.where(ua, ua_code, vabort_code),
                                     jnp.full((txn.B,), NULL_KEY, jnp.int32))
+            # flight recorder: close completing spans before the slot
+            # frees (the end-of-tick accumulators skip harvested lanes)
+            stats = obs_flight.harvest_spans(stats, commit | ua, ua, txn, t)
             txn = txn._replace(status=jnp.where(commit | ua, STATUS_FREE,
                                                 txn.status))
             return txn, db, data, tables, stats, commit, vabort, ua
@@ -744,7 +763,9 @@ def make_tick(cfg: Config, plugin, pool_dev: dict, workload=None):
                     jnp.int32(cc_base.REASON["backoff_reabort"]), code_b)
                 code_b = jnp.where(vabort, vabort_code, code_b)
                 stats = note_aborts(cfg, stats, code_b, abort_now,
-                                    measuring)
+                                    measuring, t=t,
+                                    key_b=jnp.where(acc_fail, fail_key,
+                                                    NULL_KEY))
                 stats = note_last_abort(
                     stats, abort_now, code_b,
                     jnp.where(acc_fail, fail_key, NULL_KEY))
@@ -801,7 +822,7 @@ def make_tick(cfg: Config, plugin, pool_dev: dict, workload=None):
                          jnp.sum(vabort.astype(jnp.int32)), measuring)
             stats = note_aborts(cfg, stats,
                                 jnp.full((txn.B,), vabort_code, jnp.int32),
-                                vabort, measuring)
+                                vabort, measuring, t=t)
             txn = txn._replace(
                 status=jnp.where(vabort, STATUS_BACKOFF, txn.status),
                 cursor=jnp.where(vabort, 0, txn.cursor),
@@ -814,6 +835,8 @@ def make_tick(cfg: Config, plugin, pool_dev: dict, workload=None):
 
         # latency decomposition integrals: txn-ticks per end-of-tick state
         stats = track_state_latencies(stats, txn, measuring)
+        # flight recorder: per-slot mirror of the same masks + gate
+        stats = obs_flight.track_phases(stats, txn, t, measuring)
         if cfg.trace_ticks > 0:
             live_delta, ovf_delta = 0, 0
             if "live_entry_cnt" in db:

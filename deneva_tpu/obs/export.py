@@ -1,0 +1,149 @@
+"""Unified Perfetto export: run records -> one Chrome trace file.
+
+``python -m deneva_tpu.obs.export run_*.json [-o trace.json]`` merges
+every given run record (obs/profiler.py write_run_record documents) into
+ONE Chrome trace-event JSON loadable at ui.perfetto.dev:
+
+- the per-tick counter tracks rebuilt from each record's ``timeline``
+  series (the same six-track grouping as obs/trace.py to_chrome_trace:
+  txn flow, slot occupancy, compaction, plus the conditional abort-
+  reasons and admission-queue tracks);
+- the per-txn SPAN track from each record's ``flight`` snapshot
+  (obs/flight.py span_events: nested lifecycle/attempt slices with
+  abort-reason flow arrows) — counters above, the sampled lifecycles
+  that explain them below, on one shared tick clock.
+
+Records merge side by side as separate Perfetto process groups (one pid
+block per record, per node), so a 7-algorithm bench sweep reads as seven
+labelled lanes in one timeline.  Like obs/xmeter.py and obs/regress.py,
+this module is deliberately NOT imported by obs/__init__ — ``python -m``
+execution would otherwise warn about the double import.
+"""
+
+from __future__ import annotations
+
+import json
+
+# per-record pid stride: node pids of record i live in [i*stride, ...);
+# 4096 nodes per record is far beyond any mesh this build drives
+PID_STRIDE = 4096
+
+#: counter-track grouping, mirroring obs/trace.py to_chrome_trace
+_TRACKS = (("txn flow", ("admit", "commit", "abort", "vabort",
+                         "user_abort", "lock_wait")),
+           ("slot occupancy", ("occ_free", "occ_running", "occ_waiting",
+                               "occ_backoff")),
+           ("compaction", ("live_entries", "compact_ovf")))
+
+
+def _series(timeline: dict, name: str, node: int, n_nodes: int):
+    """One record timeline column as a flat per-tick list for ``node``
+    (cluster records may store (N, T) nested lists; flat (T,) series are
+    node 0's — and the cluster sum's — view)."""
+    col = timeline.get(name)
+    if col is None:
+        return None
+    if col and isinstance(col[0], list):      # (N, T) per-shard series
+        return col[node] if node < len(col) else None
+    return col if node == 0 else None
+
+
+def record_events(rec: dict, pid_base: int = 0, tick_us: float = 1.0,
+                  label: str = "") -> list:
+    """Trace events for ONE run record: counter tracks from its
+    ``timeline`` plus the span track from its ``flight`` snapshot."""
+    events = []
+    timeline = rec.get("timeline") or {}
+    flight = rec.get("flight")
+    n_nodes = 1
+    for col in timeline.values():
+        if col and isinstance(col[0], list):
+            n_nodes = max(n_nodes, len(col))
+    if flight:
+        n_nodes = max(n_nodes, int(flight.get("nodes", 1)))
+    reason_names = sorted(k for k in timeline if k.startswith("abort_"))
+    for node in range(n_nodes):
+        pid = pid_base + node
+        pname = label or "engine"
+        if n_nodes > 1:
+            pname = f"{pname}/shard{node}"
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "tid": 0, "args": {"name": pname}})
+        for track, cols in _TRACKS:
+            series = {c: _series(timeline, c, node, n_nodes)
+                      for c in cols}
+            series = {c: s for c, s in series.items() if s is not None}
+            if not series:
+                continue
+            T = min(len(s) for s in series.values())
+            for t in range(T):
+                events.append({"name": track, "ph": "C",
+                               "ts": float(t) * tick_us, "pid": pid,
+                               "args": {c: int(series[c][t])
+                                        for c in series}})
+        for t_name, cols in (("abort reasons", reason_names),
+                             ("admission queue", ("queue_depth",))):
+            series = {c: _series(timeline, c, node, n_nodes)
+                      for c in cols}
+            series = {c: s for c, s in series.items() if s is not None}
+            if not series:
+                continue
+            T = min(len(s) for s in series.values())
+            for t in range(T):
+                events.append({"name": t_name, "ph": "C",
+                               "ts": float(t) * tick_us, "pid": pid,
+                               "args": {c: int(series[c][t])
+                                        for c in series}})
+    if flight:
+        from deneva_tpu.obs import flight as obs_flight
+        for ev in obs_flight.span_events(flight, tick_us=tick_us):
+            ev = dict(ev)
+            ev["pid"] = pid_base + ev["pid"]
+            events.append(ev)
+    return events
+
+
+def export(paths, out_path: str, tick_us: float = 1.0) -> dict:
+    """Merge the run records at ``paths`` into one Chrome trace at
+    ``out_path``; returns the metadata block (record labels + counts)."""
+    events = []
+    labels = []
+    for i, path in enumerate(paths):
+        with open(path) as f:
+            rec = json.load(f)
+        cfg = rec.get("config") or {}
+        label = str(cfg.get("cc_alg") or rec.get("config_fingerprint")
+                    or path)
+        labels.append(label)
+        events.extend(record_events(rec, pid_base=i * PID_STRIDE,
+                                    tick_us=tick_us, label=label))
+    meta = {"tool": "deneva_tpu.obs.export", "records": labels,
+            "tick_us": tick_us, "events": len(events)}
+    with open(out_path, "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms",
+                   "metadata": meta}, f)
+    return meta
+
+
+def main(argv=None) -> int:
+    import argparse
+    p = argparse.ArgumentParser(
+        description="merge run records into one Perfetto/Chrome trace "
+                    "(counter tracks + per-txn flight span track)")
+    p.add_argument("records", nargs="+",
+                   help="run_record JSON paths (obs/profiler.py)")
+    p.add_argument("-o", "--out", default="trace_merged.json",
+                   help="output Chrome trace path")
+    p.add_argument("--tick-us", type=float, default=1.0,
+                   help="microseconds per scheduler tick on the trace "
+                        "timebase")
+    args = p.parse_args(argv)
+    meta = export(args.records, args.out, tick_us=args.tick_us)
+    print(f"wrote {args.out}: {meta['events']} events from "
+          f"{len(meta['records'])} record(s) "
+          f"({', '.join(meta['records'])})")
+    return 0
+
+
+if __name__ == "__main__":           # pragma: no cover - CLI shim
+    raise SystemExit(main())

@@ -1,0 +1,458 @@
+"""Transaction flight recorder: per-txn lifecycle spans (obs pillar 7).
+
+Everything the repo measured before this module is AGGREGATE — lat_*
+integrals, per-reason abort counters, per-tick counter tracks — which
+answers "where did the fleet's time go" but never "why was THIS p99
+transaction slow", the question the reference's per-txn state machine
+(txn.cpp lifecycle + stats.cpp lat_* families) was instrumented for.
+Opt-in through ``Config.flight`` (requires ``abort_attribution``), the
+engine carries three device planes inside the stats dict:
+
+- **open-span columns** (``(B,)`` per slot): admission tick
+  (``arr_flight_admit``; -1 = slot idle), first-acquire tick
+  (``arr_flight_facq``; -1 until the cursor first advances), and one
+  warmup-gated tick accumulator per lifecycle phase mirroring the lat_*
+  vocabulary — ``queue`` (client arrival -> admission, open-system runs),
+  ``proc`` (RUNNING), ``block`` (WAITING), ``backoff`` (BACKOFF),
+  ``net`` (sharded: blocked on message transit / remote entries);
+- **completed-span ring** ``arr_flight_span`` (``(S, C)``,
+  ``Config.flight_samples`` rows x FLIGHT_COLUMNS): harvested at the
+  commit/user-abort bookkeeping site with the repo's keep-last ring +
+  distinct-OOB-dead-lane scatter discipline (LINT.md);
+- **restart-event ring** ``arr_flight_ev`` (``(4S, E)`` x
+  EVENT_COLUMNS): one row per abort EVENT, appended inside
+  ``note_aborts`` — i.e. at EXACTLY the sites that bump the aggregate
+  abort counters, with the same masks and the same code normalization
+  as ``_reason_hist`` — so the measured-window event histogram equals
+  the ``abort_<reason>_cnt`` taxonomy exactly (including the reference's
+  vabort double-count).
+
+Exactness contract (the PR 4 taxonomy / PR 6 conservation discipline):
+in full-sampling mode — rings never wrap, ``flight_qdrop_cnt == 0`` —
+
+    sum(span.phase) + sum(open-slot accumulators)  ==  lat_<phase> integral
+    hist(events at tick >= warmup)                 ==  abort_*_cnt
+
+for every plugin and both engines (tests/test_flight.py).  Sampled mode
+(small S) degrades to a keep-last window of recent completions, the
+StatsArr analog.
+
+In ``ShardedEngine`` the stats dict is stacked over the node axis, so
+the rings arrive ``(N, S, C)``; :func:`snapshot` tags each span/event
+with its node and merges per-node rings onto the one lockstep tick
+clock.  Host-side exports:
+
+- :func:`snapshot`          numpy -> dicts (spans / open spans / events);
+- :func:`span_events`       Perfetto DURATION slices ("X") per sampled
+                            txn with nested per-attempt slices and
+                            abort-reason FLOW arrows ("s"/"f") across
+                            restarts — a span track beside the six
+                            counter tracks of obs/trace.py;
+- :func:`tail_attribution`  the [tail] report section (obs/report.py):
+                            dominant phase + abort reasons + hot keys
+                            of the p99-and-above latency cohort;
+- :func:`reconcile`         the exact identities above, as a mismatch
+                            list (tests + the bench --flight gate).
+
+When ``Config.flight`` is False (default) no arrays are carried and the
+[summary] line is byte-identical to a build without this module.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from deneva_tpu.cc import base as cc_base
+from deneva_tpu.engine.state import (NULL_KEY, STATUS_BACKOFF, STATUS_FREE,
+                                     STATUS_RUNNING, STATUS_WAITING)
+
+#: completed-span row schema.  ``admit``/``facq``/``end`` are ticks
+#: (``facq`` = first cursor advance; a txn that commits the tick it was
+#: admitted stamps ``facq = end``); ``kind`` 0 = commit, 1 = user abort;
+#: ``restarts`` the attempt count at completion; the five phase columns
+#: are warmup-gated tick counts mirroring the lat_* vocabulary (queue ->
+#: lat_work_queue_time, proc -> lat_process_time, block ->
+#: lat_cc_block_time, backoff -> lat_abort_time, net -> lat_network_time).
+FLIGHT_COLUMNS = ("slot", "admit", "facq", "end", "kind", "restarts",
+                  "queue", "proc", "block", "backoff", "net")
+FCOL = {name: i for i, name in enumerate(FLIGHT_COLUMNS)}
+
+#: abort-event row schema: the tick the event was counted, the slot it
+#: hit, the NORMALIZED reason code (same clamp as _reason_hist, so the
+#: host histogram partitions exactly like abort_*_cnt) and the failing
+#: access key (NULL_KEY for whole-txn events: validation/user aborts).
+EVENT_COLUMNS = ("tick", "slot", "code", "key")
+ECOL = {name: i for i, name in enumerate(EVENT_COLUMNS)}
+
+#: event ring depth = EV_FACTOR * Config.flight_samples (a txn restarts
+#: several times per completion under contention)
+EV_FACTOR = 4
+
+#: span phase column -> the lat_* integral it reconciles against
+PHASE_KEYS = (("queue", "lat_work_queue_time"),
+              ("proc", "lat_process_time"),
+              ("block", "lat_cc_block_time"),
+              ("backoff", "lat_abort_time"),
+              ("net", "lat_network_time"))
+
+_ACCS = ("queue", "proc", "block", "backoff", "net")
+
+
+# ---------------------------------------------------------------------------
+# device side (jit-safe; every helper no-ops when the plane is absent)
+# ---------------------------------------------------------------------------
+
+def init_flight(cfg) -> dict:
+    """Stats-dict entries for the recorder; empty when off (the disabled
+    path carries nothing)."""
+    if not cfg.flight:
+        return {}
+    B, S = cfg.batch_size, cfg.flight_samples
+    out = {
+        "arr_flight_admit": jnp.full((B,), -1, jnp.int32),
+        "arr_flight_facq": jnp.full((B,), -1, jnp.int32),
+        "arr_flight_span": jnp.zeros((S, len(FLIGHT_COLUMNS)), jnp.int32),
+        "arr_flight_ev": jnp.zeros((EV_FACTOR * S, len(EVENT_COLUMNS)),
+                                   jnp.int32),
+        # cumulative harvest counts double as ring cursors (pos = cnt +
+        # rank mod cap) and as the host's wrap detector; flight_-prefixed
+        # scalars surface in [summary] (stats.py passthrough)
+        "flight_span_cnt": jnp.zeros((), jnp.int32),
+        "flight_ev_cnt": jnp.zeros((), jnp.int32),
+    }
+    for a in _ACCS:
+        out[f"arr_flight_{a}"] = jnp.zeros((B,), jnp.int32)
+    return out
+
+
+def note_admit(stats: dict, free, t, qwait=None) -> dict:
+    """Open a span on this tick's admitted lanes: stamp the admission
+    tick, reset first-acquire and the phase accumulators, and bank the
+    pre-admission work-queue wait (``qwait``, from the arrival-tick ring
+    of traffic/arrival.py; None/0 for closed-loop runs)."""
+    if "arr_flight_admit" not in stats:
+        return stats
+    out = dict(stats)
+    out["arr_flight_admit"] = jnp.where(free, t, stats["arr_flight_admit"])
+    out["arr_flight_facq"] = jnp.where(free, -1, stats["arr_flight_facq"])
+    for a in _ACCS:
+        k = f"arr_flight_{a}"
+        v = qwait if (a == "queue" and qwait is not None) else 0
+        out[k] = jnp.where(free, v, stats[k])
+    return out
+
+
+def harvest_spans(stats: dict, done, ua, txn, t) -> dict:
+    """Close the spans of this tick's completing txns (``done`` = commit
+    | user-abort) into the keep-last ring and mark their slots idle so
+    the end-of-tick accumulators never double-count a freed lane.  Same
+    scatter discipline as record_commit_latency: survivors of a
+    sequential append occupy distinct in-ring positions mod S, dead
+    lanes map to DISTINCT out-of-bounds rows."""
+    if "arr_flight_span" not in stats:
+        return stats
+    ring = stats["arr_flight_span"]
+    S = ring.shape[0]
+    B = done.shape[0]
+    admit = stats["arr_flight_admit"]
+    rec = done & (admit >= 0)
+    facq = stats["arr_flight_facq"]
+    row = jnp.stack([
+        jnp.arange(B, dtype=jnp.int32),                 # slot
+        admit,
+        jnp.where(facq < 0, t, facq),                   # same-tick commit
+        jnp.full((B,), t, jnp.int32),                   # end
+        jnp.where(ua, 1, 0).astype(jnp.int32),          # kind
+        txn.restarts,
+    ] + [stats[f"arr_flight_{a}"] for a in _ACCS], axis=1)  # (B, C)
+    rank = jnp.cumsum(rec.astype(jnp.int32)) - rec.astype(jnp.int32)
+    n = jnp.sum(rec.astype(jnp.int32))
+    live = rec & (rank >= n - S)
+    pos = jnp.where(live, (stats["flight_span_cnt"] + rank) % S,
+                    S + jnp.arange(B, dtype=jnp.int32))
+    out = {**stats,
+           "arr_flight_span": ring.at[pos].set(row, mode="drop",
+                                               unique_indices=True),
+           "flight_span_cnt": stats["flight_span_cnt"] + n,
+           "arr_flight_admit": jnp.where(rec, -1, admit)}
+    for a in _ACCS:
+        k = f"arr_flight_{a}"
+        out[k] = jnp.where(rec, 0, stats[k])
+    return out
+
+
+def track_phases(stats: dict, txn, t, measuring) -> dict:
+    """End-of-tick per-slot phase accumulation — the per-txn mirror of
+    track_state_latencies, applied with the SAME status masks and the
+    same warmup gate, so summed span phases reconcile exactly against
+    the lat_* integrals.  Also stamps the first-acquire tick the first
+    time a live txn's cursor leaves 0."""
+    if "arr_flight_admit" not in stats:
+        return stats
+    open_ = stats["arr_flight_admit"] >= 0
+    m = measuring & open_
+    out = dict(stats)
+    for a, st_v in (("proc", STATUS_RUNNING), ("block", STATUS_WAITING),
+                    ("backoff", STATUS_BACKOFF)):
+        k = f"arr_flight_{a}"
+        out[k] = stats[k] + jnp.where(m & (txn.status == st_v), 1, 0)
+    facq = stats["arr_flight_facq"]
+    out["arr_flight_facq"] = jnp.where(
+        open_ & (facq < 0) & (txn.cursor > 0) & (txn.status != STATUS_FREE),
+        t, facq)
+    return out
+
+
+def track_net(stats: dict, inc_b, measuring) -> dict:
+    """Per-slot network-phase accumulation (sharded engine): ``inc_b``
+    is the SAME per-txn population whose sum bumps lat_network_time this
+    tick — blocked-on-transit bools in net-delay mode, remote-entry
+    counts in the D=0 proxy — so the identity holds in both modes."""
+    if "arr_flight_net" not in stats:
+        return stats
+    inc = jnp.where(measuring & (stats["arr_flight_admit"] >= 0),
+                    inc_b.astype(jnp.int32), 0)
+    return {**stats, "arr_flight_net": stats["arr_flight_net"] + inc}
+
+
+def record_events(stats: dict, code_b, mask_b, t, key_b=None) -> dict:
+    """Append one abort-event row per masked lane (called from
+    note_aborts, so event sites == counter sites).  Codes are normalized
+    exactly like _reason_hist (<=0 -> "other", high codes clamp), hence
+    hist(measured events) == abort_*_cnt.  NOT warmup-gated — the host
+    filters by tick for the reconciliation, keeps all for the trace."""
+    if "arr_flight_ev" not in stats:
+        return stats
+    ring = stats["arr_flight_ev"]
+    cap = ring.shape[0]
+    B = mask_b.shape[0]
+    n_reg = len(cc_base.ABORT_REASONS)
+    code = jnp.where(code_b <= 0, jnp.int32(cc_base.REASON["other"]), code_b)
+    code = jnp.minimum(code, n_reg)
+    # lint: disable-next=TRACED-BRANCH is-None STRUCTURE check: key_b is None iff the caller carries no key column (static per call site), never a traced-value branch
+    if key_b is None:
+        key_b = jnp.full((B,), NULL_KEY, jnp.int32)
+    row = jnp.stack([jnp.full((B,), t, jnp.int32),
+                     jnp.arange(B, dtype=jnp.int32),
+                     code, key_b], axis=1)
+    rank = jnp.cumsum(mask_b.astype(jnp.int32)) - mask_b.astype(jnp.int32)
+    n = jnp.sum(mask_b.astype(jnp.int32))
+    live = mask_b & (rank >= n - cap)
+    pos = jnp.where(live, (stats["flight_ev_cnt"] + rank) % cap,
+                    cap + jnp.arange(B, dtype=jnp.int32))
+    return {**stats,
+            "arr_flight_ev": ring.at[pos].set(row, mode="drop",
+                                              unique_indices=True),
+            "flight_ev_cnt": stats["flight_ev_cnt"] + n}
+
+
+# ---------------------------------------------------------------------------
+# host side
+# ---------------------------------------------------------------------------
+
+def _ring_rows(ring: np.ndarray, cnt: int) -> np.ndarray:
+    """Valid rows of a keep-last ring in chronological order."""
+    cap = ring.shape[0]
+    if cnt <= cap:
+        return ring[:cnt]
+    return np.roll(ring, -(cnt % cap), axis=0)
+
+
+def snapshot(state_or_stats) -> dict:
+    """Fetch the recorder planes as plain dicts (JSON-ready; lands in
+    profiler run records under the top-level ``"flight"`` key).  Sharded
+    states arrive node-stacked; every span/event gains a ``node`` field
+    and the per-node rings merge on the shared tick clock."""
+    stats = getattr(state_or_stats, "stats", state_or_stats)
+    assert "arr_flight_span" in stats, "run with Config.flight"
+    span = np.asarray(stats["arr_flight_span"])
+    ev = np.asarray(stats["arr_flight_ev"])
+    if span.ndim == 2:                       # single shard -> 1-node stack
+        span, ev = span[None], ev[None]
+        scnt = np.asarray(stats["flight_span_cnt"]).reshape(1)
+        ecnt = np.asarray(stats["flight_ev_cnt"]).reshape(1)
+        admit = np.asarray(stats["arr_flight_admit"])[None]
+        facq = np.asarray(stats["arr_flight_facq"])[None]
+        accs = {a: np.asarray(stats[f"arr_flight_{a}"])[None]
+                for a in _ACCS}
+    else:
+        scnt = np.asarray(stats["flight_span_cnt"])
+        ecnt = np.asarray(stats["flight_ev_cnt"])
+        admit = np.asarray(stats["arr_flight_admit"])
+        facq = np.asarray(stats["arr_flight_facq"])
+        accs = {a: np.asarray(stats[f"arr_flight_{a}"]) for a in _ACCS}
+    N, S, _ = span.shape
+    reasons = ("?",) + tuple(cc_base.ABORT_REASONS)
+    spans, events, opens = [], [], []
+    for node in range(N):
+        for r in _ring_rows(span[node], int(scnt[node])):
+            d = {c: int(r[i]) for i, c in enumerate(FLIGHT_COLUMNS)}
+            d["node"] = node
+            spans.append(d)
+        for r in _ring_rows(ev[node], int(ecnt[node])):
+            d = {c: int(r[i]) for i, c in enumerate(EVENT_COLUMNS)}
+            d["node"] = node
+            d["reason"] = reasons[min(max(d["code"], 0), len(reasons) - 1)]
+            events.append(d)
+        for slot in np.nonzero(admit[node] >= 0)[0]:
+            d = {"node": node, "slot": int(slot),
+                 "admit": int(admit[node][slot]),
+                 "facq": int(facq[node][slot])}
+            d.update({a: int(accs[a][node][slot]) for a in _ACCS})
+            opens.append(d)
+    # merged view stays tick-sorted across nodes (one lockstep clock)
+    spans.sort(key=lambda d: (d["end"], d["node"], d["slot"]))
+    events.sort(key=lambda d: (d["tick"], d["node"], d["slot"]))
+    out = {"columns": list(FLIGHT_COLUMNS),
+           "event_columns": list(EVENT_COLUMNS),
+           "nodes": N, "samples": S,
+           "span_cnt": int(scnt.sum()), "ev_cnt": int(ecnt.sum()),
+           "span_wrapped": bool((scnt > S).any()),
+           "ev_wrapped": bool((ecnt > ev.shape[1]).any()),
+           "spans": spans, "events": events, "open_spans": opens}
+    qd = stats.get("flight_qdrop_cnt")
+    if qd is not None:
+        out["qdrop_cnt"] = int(np.asarray(qd).sum())
+    return out
+
+
+def reconcile(snap: dict, summary: dict, warmup_ticks: int = 0) -> list:
+    """The full-sampling exactness checks, as ``(what, got, want)``
+    mismatch tuples (empty = exact).  Valid only while no ring wrapped
+    (the caller's full-sampling contract); a wrapped ring or dropped
+    queue stamps are reported as findings rather than silently passed."""
+    bad = []
+    if snap["span_wrapped"]:
+        bad.append(("span_ring_wrapped", snap["span_cnt"], snap["samples"]))
+    if snap["ev_wrapped"]:
+        bad.append(("ev_ring_wrapped", snap["ev_cnt"],
+                    EV_FACTOR * snap["samples"]))
+    if bad:
+        return bad
+    both = snap["spans"] + snap["open_spans"]
+    for col, key in PHASE_KEYS:
+        want = summary.get(key)
+        if want is None or (col == "queue" and snap.get("qdrop_cnt")):
+            continue   # plane absent (closed loop / single shard) or
+        got = sum(d[col] for d in both)     # queue stamps invalidated
+        if col == "queue":
+            # still-queued clients at run end hold wait the integral
+            # already counted; the caller folds that residual in via
+            # summary["flight_queue_residual"] (tests compute it)
+            got += summary.get("flight_queue_residual", 0)
+        if got != int(want):
+            bad.append((col, got, int(want)))
+    hist: dict = {}
+    for e in snap["events"]:
+        if e["tick"] >= warmup_ticks:
+            hist[e["reason"]] = hist.get(e["reason"], 0) + 1
+    for name in cc_base.ABORT_REASONS:
+        want = int(summary.get(f"abort_{name}_cnt", 0))
+        got = hist.get(name, 0)
+        if got != want:
+            bad.append((f"abort_{name}", got, want))
+    return bad
+
+
+def tail_attribution(snap: dict, pct: float = 99.0, topk: int = 5) -> dict:
+    """Attribute the latency tail: over completed spans, take the
+    ``pct``-and-above cohort by total latency (end - admit) and report
+    which lifecycle phase dominates it (vs the all-spans baseline),
+    which abort reasons its restarts hit, and which keys those restarts
+    failed on — the "why is THIS p99 slow" answer."""
+    spans = [d for d in snap["spans"] if d["kind"] == 0]
+    if not spans:
+        return {"n": 0, "cohort": 0}
+    lat = np.asarray([d["end"] - d["admit"] for d in spans], np.int64)
+    thresh = float(np.percentile(lat, pct))
+    cohort = [d for d, l in zip(spans, lat) if l >= thresh]
+
+    def shares(pop):
+        tot = {a: sum(d[a] for d in pop) for a in _ACCS}
+        s = max(sum(tot.values()), 1)
+        return tot, {a: tot[a] / s for a in _ACCS}
+
+    c_ticks, c_share = shares(cohort)
+    _, all_share = shares(spans)
+    # join restart events into the cohort's lifetimes (node, slot, window)
+    win = {}
+    for d in cohort:
+        win.setdefault((d["node"], d["slot"]), []).append(
+            (d["admit"], d["end"]))
+    reasons: dict = {}
+    keys: dict = {}
+    for e in snap["events"]:
+        for lo, hi in win.get((e["node"], e["slot"]), ()):
+            if lo <= e["tick"] <= hi:
+                reasons[e["reason"]] = reasons.get(e["reason"], 0) + 1
+                if e["key"] != NULL_KEY:
+                    keys[e["key"]] = keys.get(e["key"], 0) + 1
+                break
+    top = lambda d: sorted(d.items(), key=lambda kv: -kv[1])[:topk]
+    return {"n": len(spans), "cohort": len(cohort),
+            "p_ticks": thresh, "pct": pct,
+            "max_ticks": int(lat.max()),
+            "phase_ticks": c_ticks, "phase_share": c_share,
+            "all_share": all_share,
+            "dominant_phase": max(c_share, key=lambda a: c_share[a]),
+            "avg_restarts": (sum(d["restarts"] for d in cohort)
+                             / max(len(cohort), 1)),
+            "top_reasons": top(reasons), "top_keys": top(keys)}
+
+
+def span_events(snap: dict, tick_us: float = 1.0) -> list:
+    """Perfetto DURATION events for the sampled spans — the span track
+    beside the counter tracks of obs/trace.py to_chrome_trace.  One
+    process per node, one thread per slot (a slot hosts one txn at a
+    time, so its spans never overlap); each txn is an "X" slice spanning
+    admit..end with nested per-attempt child slices split at its abort
+    events, linked by abort-reason FLOW arrows ("s" -> "f") so a restart
+    chain reads left-to-right across the track."""
+    events = []
+    seen_threads = set()
+    flow_id = 0
+    by_owner: dict = {}
+    for e in snap["events"]:
+        by_owner.setdefault((e["node"], e["slot"]), []).append(e)
+    for d in snap["spans"]:
+        pid, tid = d["node"], d["slot"]
+        if (pid, tid) not in seen_threads:
+            seen_threads.add((pid, tid))
+            events.append({"name": "thread_name", "ph": "M", "pid": pid,
+                           "tid": tid, "args": {"name": f"slot{tid}"}})
+        t0, t1 = d["admit"], d["end"]
+        dur = max(t1 - t0, 0) + 1           # inclusive tick span
+        kind = "user_abort" if d["kind"] else "txn"
+        events.append({
+            "name": kind, "cat": "flight", "ph": "X",
+            "ts": t0 * tick_us, "dur": dur * tick_us,
+            "pid": pid, "tid": tid,
+            "args": {k: d[k] for k in ("facq", "restarts", *_ACCS)}})
+        mine = [e for e in by_owner.get((pid, tid), ())
+                if t0 <= e["tick"] <= t1]
+        # attempt boundaries at the (deduped) abort ticks; a vabort's
+        # double-counted event collapses into one boundary
+        cuts = sorted({e["tick"] for e in mine})
+        lo = t0
+        for i, cut in enumerate(cuts):
+            events.append({
+                "name": f"attempt{i}", "cat": "flight", "ph": "X",
+                "ts": lo * tick_us, "dur": max(cut - lo, 0) * tick_us
+                + tick_us, "pid": pid, "tid": tid, "args": {}})
+            reason = next(e["reason"] for e in mine if e["tick"] == cut)
+            flow_id += 1
+            events.append({"name": reason, "cat": "abort-flow", "ph": "s",
+                           "id": flow_id, "ts": cut * tick_us,
+                           "pid": pid, "tid": tid})
+            events.append({"name": reason, "cat": "abort-flow", "ph": "f",
+                           "bp": "e", "id": flow_id,
+                           "ts": min(cut + 1, t1) * tick_us,
+                           "pid": pid, "tid": tid})
+            lo = min(cut + 1, t1)
+        events.append({
+            "name": f"attempt{len(cuts)}", "cat": "flight", "ph": "X",
+            "ts": lo * tick_us, "dur": max(t1 - lo, 0) * tick_us + tick_us,
+            "pid": pid, "tid": tid, "args": {}})
+    return events

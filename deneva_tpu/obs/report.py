@@ -150,14 +150,17 @@ def hot_keys(stats: dict, topk: int = 8) -> list:
 
 def build_report(summary: dict, timeline: dict | None = None,
                  stats: dict | None = None, topk: int = 8,
-                 xmeter: dict | None = None) -> dict:
+                 xmeter: dict | None = None,
+                 flight: dict | None = None) -> dict:
     """The machine-readable waterfall: phases (slot-ticks + share),
     throughput, the abort taxonomy, hot keys / per-partition conflicts /
     wait-depth histogram (when the run kept a heatmap), reconciliation
     failures and watchdog findings.  ``xmeter`` (an
     obs/xmeter.py XMeter.snapshot()) adds the compile/roofline section:
     per-entry compile counts, post-warmup violations, and the
-    achieved-vs-peak roofline rows."""
+    achieved-vs-peak roofline rows.  ``flight`` (an obs/flight.py
+    ``snapshot()``) adds the ``[tail]`` section: which lifecycle phase,
+    abort reasons and keys dominate the p99-and-above latency cohort."""
     phases = {}
     total = 0
     for phase, key, _ in _PHASES:
@@ -197,6 +200,9 @@ def build_report(summary: dict, timeline: dict | None = None,
                                                  [])),
             "roofline": list(xmeter.get("roofline", [])),
         }
+    if flight is not None:
+        from deneva_tpu.obs.flight import tail_attribution
+        rep["tail"] = tail_attribution(flight, topk=topk)
     rep["reconcile_failures"] = reconcile(summary, timeline)
     findings, code = watchdog(summary, timeline,
                               precomputed_reconcile=rep["reconcile_failures"])
@@ -337,6 +343,27 @@ def render_text(rep: dict) -> str:
                     f"({r['peak_flop_frac']:6.2%})  "
                     f"{r['achieved_gbps']:>8.2f} GB/s "
                     f"({r['peak_bw_frac']:6.2%})  {r['bound']}-bound")
+    if rep.get("tail") is not None:
+        tl = rep["tail"]
+        if tl.get("cohort"):
+            lines.append(
+                f"[tail] p{tl['pct']:g} cohort: {tl['cohort']}/{tl['n']} "
+                f"spans at >= {tl['p_ticks']:.0f} ticks "
+                f"(max {tl['max_ticks']}), avg {tl['avg_restarts']:.1f} "
+                f"restarts, dominant phase {tl['dominant_phase']}")
+            total_t = max(sum(tl["phase_ticks"].values()), 1)
+            for phase, v in tl["phase_ticks"].items():
+                frac = v / total_t
+                delta = frac - tl["all_share"].get(phase, 0.0)
+                bar = "#" * int(round(frac * 40))
+                lines.append(f"  {phase:<14} {bar:<40} {v:>10} "
+                             f"({frac:6.1%}, {delta:+6.1%} vs all)")
+            for name, c in tl.get("top_reasons", []):
+                lines.append(f"  tail-abort {name:<20} {c}")
+            for key, c in tl.get("top_keys", []):
+                lines.append(f"  tail-key   {key:<20} {c}")
+        else:
+            lines.append("[tail] no completed spans sampled")
     for flag, msg in rep["watchdog"]["findings"]:
         lines.append(f"[watchdog] {flag}: {msg}")
     if not rep["watchdog"]["findings"]:
@@ -348,7 +375,8 @@ def report_from_record(rec: dict) -> dict:
     """Build the report from a run-record JSON document
     (obs/profiler.py write_run_record)."""
     return build_report(rec["summary"], rec.get("timeline"),
-                        xmeter=rec.get("xmeter"))
+                        xmeter=rec.get("xmeter"),
+                        flight=rec.get("flight"))
 
 
 def main(argv=None) -> int:

@@ -217,7 +217,8 @@ def totals(state_or_stats) -> dict:
 
 def to_chrome_trace(state_or_stats, path: str, n_ticks: int | None = None,
                     tick_us: float = 1.0,
-                    xmeter: dict | None = None) -> str:
+                    xmeter: dict | None = None,
+                    flight: dict | None = None) -> str:
     """Export the timeline as Chrome trace-event JSON (the JSON Array
     Format with counter events, loadable at ui.perfetto.dev).
 
@@ -227,7 +228,10 @@ def to_chrome_trace(state_or_stats, path: str, n_ticks: int | None = None,
     plots; the default keeps tick units).  ``xmeter`` (an obs/xmeter.py
     ``XMeter.snapshot()``) adds a 5th counter track, "kernel ms": the
     metered per-call blocked durations of every jitted entry point,
-    indexed by call number on the same timebase."""
+    indexed by call number on the same timebase.  ``flight`` (an
+    obs/flight.py ``snapshot()``) adds the per-txn SPAN track beside the
+    counter tracks: one duration slice per sampled txn lifecycle with
+    nested per-attempt child slices and abort-reason flow arrows."""
     a = _buffer(state_or_stats)
     shards = a[None] if a.ndim == 2 else a          # (N, T, K)
     rbuf = _reason_buffer(state_or_stats)
@@ -292,6 +296,15 @@ def to_chrome_trace(state_or_stats, path: str, n_ticks: int | None = None,
                 events.append({"name": "kernel ms", "ph": "C",
                                "ts": float(i) * tick_us, "pid": 0,
                                "args": {name: float(ms)}})
+    n_spans = 0
+    if flight:
+        # per-txn span track (same conditional discipline as the other
+        # optional tracks): obs/flight.py renders its own Perfetto
+        # duration/flow events on the shared tick_us timebase — the
+        # sampled lifecycles line up under the counter rows above
+        from deneva_tpu.obs import flight as obs_flight
+        events.extend(obs_flight.span_events(flight, tick_us=tick_us))
+        n_spans = len(flight.get("spans", ()))
     doc = {"traceEvents": events, "displayTimeUnit": "ms",
            "metadata": {"tool": "deneva_tpu.obs.trace",
                         "columns": list(TRACE_COLUMNS),
@@ -302,6 +315,8 @@ def to_chrome_trace(state_or_stats, path: str, n_ticks: int | None = None,
         doc["metadata"]["queue_track"] = True
     if xentries:
         doc["metadata"]["xmeter_entries"] = xentries
+    if flight:
+        doc["metadata"]["flight_spans"] = n_spans
     with open(path, "w") as f:
         json.dump(doc, f)
     return path

@@ -61,6 +61,7 @@ from deneva_tpu.engine.scheduler import (STAT_KEYS_F32, STAT_KEYS_I32,  # noqa: 
                                          record_commit_latency,
                                          track_parts_touched,
                                          track_state_latencies)
+from deneva_tpu.obs import flight as obs_flight
 from deneva_tpu.obs import trace as obs_trace
 from deneva_tpu.obs.prog import ProgressEmitter
 from deneva_tpu.obs.profiler import PhaseProfiler
@@ -207,7 +208,11 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
             # ISREPLICA (global.h:301): the upper mesh half runs no txns
             free = free & (node_id < n_parts)
         n_free = jnp.sum(free.astype(jnp.int32))
+        qwait = None
         if cfg.arrival is not None:
+            # flight recorder: bank each admitted lane's client wait
+            # BEFORE note_admission advances the FIFO head
+            qwait = traffic.admitted_wait(stats, free, frank, t)
             stats = traffic.note_admission(stats, avail, n_free, measuring)
 
         from deneva_tpu.engine.scheduler import pool_admit
@@ -230,6 +235,7 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
         start_tick = jnp.where(free, t, start_tick)
         first_start_tick = jnp.where(free, t, txn.first_start_tick)
         stats = bump(stats, "local_txn_start_cnt", n_free, measuring)
+        stats = obs_flight.note_admit(stats, free, t, qwait)
 
         backoff_until = txn.backoff_until
         if plugin.epoch_admission and workload.recon_types:
@@ -620,8 +626,29 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
             in_fin = finishing & (t < net["fin_ready"])
             in_vote = finishing & (net["vote_tick"] < BIG_TS) \
                 & (t < net["vote_tick"] + vote_delay)
-            net_wait_cnt = jnp.sum((in_req | in_resp | in_abt | in_fin
-                                    | in_vote).astype(jnp.int32))
+            net_wait_b = in_req | in_resp | in_abt | in_fin | in_vote
+            net_wait_cnt = jnp.sum(net_wait_b.astype(jnp.int32))
+            # per-MESSAGE in-flight integral (message.h:51-57 carries
+            # per-message queue time in the reference; lat_msg_queue_time
+            # is its rebuild: one unit per message-tick in transit).
+            # Requests: entries whose request was issued (request_all
+            # plugins launch every entry at admission, others only the
+            # cursor entry) and not yet granted; responses: granted
+            # entries still in transit home; the abort/finish/vote
+            # decision words count one message per txn.
+            issued_e = ((ridx < txn.n_req[:, None]) if plugin.request_all
+                        else (ridx == cur_pos))
+            in_req_e = active[:, None] & issued_e & (delay_e > 0) \
+                & (net["grant_tick"] == BIG_TS) \
+                & (net["abort_due"] == BIG_TS)[:, None] \
+                & (t < net["launch"][:, None] + delay_e)
+            in_resp_e = active[:, None] & (delay_e > 0) \
+                & (net["grant_tick"] < BIG_TS) \
+                & (t < net["grant_tick"] + delay_e)
+            msg_wait_cnt = (jnp.sum(in_req_e.astype(jnp.int32))
+                            + jnp.sum(in_resp_e.astype(jnp.int32))
+                            + jnp.sum((in_abt | in_fin
+                                       | in_vote).astype(jnp.int32)))
         else:
             abort_now = (blocked & at_fail(abort_e)) | vabort
 
@@ -858,6 +885,17 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
                     else:
                         stats = {**stats, "repl_acked_lsn": ack}
 
+        # flight recorder network phase: MUST accrue before harvest_spans
+        # clears the admit stamp of lanes committing this tick — the
+        # lat_network_time populations below are computed pre-commit, so
+        # banking them pre-harvest keeps span-vs-integral reconciliation
+        # exact (a txn that commits at t still pays its tick-t net wait)
+        if dly:
+            stats = obs_flight.track_net(stats, net_wait_b, measuring)
+        else:
+            rem_b = (live_e & ~local_e).reshape(B, R).sum(axis=1)
+            stats = obs_flight.track_net(stats, rem_b, measuring)
+
         # ---- 6. commit/abort bookkeeping (home) ----
         n_commit = jnp.sum(commit.astype(jnp.int32))
         stats = bump(stats, "txn_cnt", n_commit, measuring)
@@ -870,7 +908,7 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
             # vabort partition: a genuine validation failure carries the
             # plugin's vabort_reason; a routing-overflow kill is transport
             vcode_b = jnp.where(vabort_apply, vabort_code, route_code)
-            stats = note_aborts(cfg, stats, vcode_b, vabort, measuring)
+            stats = note_aborts(cfg, stats, vcode_b, vabort, measuring, t=t)
 
         stats = track_parts_touched(stats, txn, commit, n_parts, measuring)
         stats = record_commit_latency(stats, commit, t, txn.start_tick,
@@ -892,7 +930,8 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
         if cfg.abort_attribution:
             stats = note_aborts(cfg, stats,
                                 jnp.full((B,), ua_code, jnp.int32), ua,
-                                measuring)
+                                measuring, t=t)
+        stats = obs_flight.harvest_spans(stats, commit | ua, ua, txn, t)
         status = jnp.where(commit | ua, STATUS_FREE, status)
 
         stats = bump(stats, "total_txn_abort_cnt",
@@ -912,7 +951,9 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
             code_b = jnp.where(vabort,
                                jnp.where(vabort_apply, vabort_code,
                                          route_code), code_b)
-            stats = note_aborts(cfg, stats, code_b, abort_now, measuring)
+            stats = note_aborts(cfg, stats, code_b, abort_now, measuring,
+                                t=t,
+                                key_b=jnp.where(acc_ab, fail_key, NULL_KEY))
             stats = note_last_abort(
                 stats, abort_now | ua, jnp.where(ua, ua_code, code_b),
                 jnp.where(acc_ab, fail_key, NULL_KEY))
@@ -960,6 +1001,7 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
         # latency decomposition integrals (txn-ticks per end-of-tick state;
         # network = entry-ticks shipped to remote owners this tick)
         stats = track_state_latencies(stats, txn, measuring)
+        stats = obs_flight.track_phases(stats, txn, t, measuring)
         if cfg.trace_ticks > 0:
             live_delta, ovf_delta = 0, 0
             if "live_entry_cnt" in db:
@@ -985,13 +1027,14 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
             # txn-ticks spent in the network, like the reference's
             # message-carried network latency)
             stats = bump(stats, "lat_network_time", net_wait_cnt, measuring)
+            stats = bump(stats, "lat_msg_queue_time", msg_wait_cnt,
+                         measuring)
         else:
             # D=0: no transit time exists; keep the traffic proxy
-            # (remote entries shipped this tick)
-            stats = bump(
-                stats, "lat_network_time",
-                jnp.sum((live_e & ~local_e).astype(jnp.int32)),
-                measuring)
+            # (remote entries shipped this tick; rem_b banked into the
+            # flight spans pre-harvest above)
+            stats = bump(stats, "lat_network_time", jnp.sum(rem_b),
+                         measuring)
 
         # ---- 7. global ts rebase (all nodes together over ICI) ----
         limit = jnp.int32((3 << 29) // node_stride)
@@ -1140,7 +1183,13 @@ class ShardedEngine:
                            cfg,
                            n_families=int(self.pool.txn_type.max()) + 1),
                        **{k: jnp.zeros((), jnp.int32)
-                          for k in SHARD_STAT_KEYS}},
+                          for k in SHARD_STAT_KEYS},
+                       # per-message transit integral (message.h:51-57);
+                       # only a delay model makes it nonzero, and the key
+                       # exists only then (single-shard carries nothing —
+                       # deneva_tpu/stats.py defaults the absent key to 0)
+                       **({"lat_msg_queue_time": jnp.zeros((), jnp.float32)}
+                          if cfg.net_delay_ticks > 0 else {})},
                 tick=jnp.zeros((), jnp.int32),
                 pool_cursor=jnp.zeros((), jnp.int32),
                 ts_counter=jnp.ones((), jnp.int32),

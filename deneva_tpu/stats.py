@@ -84,7 +84,11 @@ def reference_summary(s: dict, wall_seconds: float | None = None) -> dict:
         # queued behind admission).  Closed-loop runs carry no backlog
         # and the key stays exactly 0.0.
         "lat_work_queue_time": s.get("lat_work_queue_time", 0.0) * tick_sec,
-        "lat_msg_queue_time": 0.0,    # exchanges happen inside the tick
+        # per-MESSAGE transit integral (message.h:51-57 mq_time): real
+        # in the sharded engine's net-delay mode (requests/responses/
+        # decision words in flight, parallel/sharded.py); single-shard
+        # exchanges happen inside the tick so the key stays exactly 0.0
+        "lat_msg_queue_time": s.get("lat_msg_queue_time", 0.0) * tick_sec,
         # CC counters
         "twopl_wait_cnt": s.get("twopl_wait_cnt", 0),
         "cc_vabort_cnt": s.get("vabort_cnt", 0),
@@ -134,6 +138,13 @@ def reference_summary(s: dict, wall_seconds: float | None = None) -> dict:
     _TRAFFIC_PREFIXES = ("arrival_", "queue_")
     for k in sorted(s):
         if k.startswith(_TRAFFIC_PREFIXES) and k not in out:
+            out[k] = s[k]
+    # flight-recorder bookkeeping (Config.flight, obs/flight.py):
+    # span/event ring fill counts and the queue-ring validity sentinel
+    # pass through verbatim (integers, never time-scaled) — present only
+    # when the recorder is on, so the default line stays byte-identical
+    for k in sorted(s):
+        if k.startswith("flight_") and k not in out:
             out[k] = s[k]
     for k in sorted(s):
         if k.startswith("famlat") and k not in out:

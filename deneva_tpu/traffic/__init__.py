@@ -2,10 +2,12 @@
 the txn pool through admission backpressure (see traffic/arrival.py for
 the model catalog and the conservation/no-drop contract)."""
 
-from deneva_tpu.traffic.arrival import (FAM_PCTS, family_percentiles,
-                                        init_arrival, note_admission,
+from deneva_tpu.traffic.arrival import (FAM_PCTS, admitted_wait,
+                                        family_percentiles, init_arrival,
+                                        note_admission,
                                         record_family_latency,
                                         sample_arrivals)
 
-__all__ = ["FAM_PCTS", "family_percentiles", "init_arrival",
-           "note_admission", "record_family_latency", "sample_arrivals"]
+__all__ = ["FAM_PCTS", "admitted_wait", "family_percentiles",
+           "init_arrival", "note_admission", "record_family_latency",
+           "sample_arrivals"]

@@ -74,6 +74,18 @@ def init_arrival(cfg, n_families: int = 1) -> dict:
     }
     if cfg.arrival == "mmpp":
         out["arr_arrival_phase"] = jnp.zeros((), jnp.int32)  # 0 calm 1 burst
+    if cfg.flight:
+        # flight recorder (obs/flight.py): arrival-tick FIFO ring so the
+        # admission stamp can bank each admitted txn's client wait (the
+        # per-txn decomposition of lat_work_queue_time).  The cumulative
+        # conservation counters double as FIFO indices: tail =
+        # arrival_cnt, head = queue_admit_cnt, both mod the ring depth.
+        out["arr_flight_qring"] = jnp.zeros(cfg.flight_samples, jnp.int32)
+        # validity sentinel, not an exact count: bumps whenever a tick's
+        # arrivals exceed the write lanes or the backlog outgrows the
+        # ring (stale cells would then be gathered); reconciliation runs
+        # require it to stay 0
+        out["flight_qdrop_cnt"] = jnp.zeros((), jnp.int32)
     return out
 
 
@@ -119,7 +131,42 @@ def sample_arrivals(cfg, stats: dict, t, node_id=None, active=None):
     n_arr = jnp.maximum(jax.random.poisson(k_arr, lam, dtype=jnp.int32), 0)
     if active is not None:
         n_arr = jnp.where(active, n_arr, 0)
+    if "arr_flight_qring" in stats:
+        # flight recorder: stamp this tick's arrivals into the FIFO ring
+        # at global indices [arrival_cnt, arrival_cnt + n_arr).  The lane
+        # count W is STATIC (rate-independent jaxpr); lanes are distinct
+        # mod the ring depth and dead lanes take DISTINCT out-of-bounds
+        # cells (LINT.md scatter discipline).  Arrivals past W — and any
+        # backlog deeper than the ring — trip the qdrop sentinel instead
+        # of silently corrupting waits.
+        ring = stats["arr_flight_qring"]
+        qcap = ring.shape[0]
+        W = min(qcap, cfg.batch_size)
+        lanes = jnp.arange(W, dtype=jnp.int32)
+        live = lanes < jnp.minimum(n_arr, W)
+        pos = jnp.where(live, (stats["arrival_cnt"] + lanes) % qcap,
+                        qcap + lanes)
+        drop = jnp.maximum(n_arr - W, 0) + jnp.maximum(
+            stats["queue_len"] + n_arr - qcap, 0)
+        stats = {**stats,
+                 "arr_flight_qring": ring.at[pos].set(
+                     t, mode="drop", unique_indices=True),
+                 "flight_qdrop_cnt": stats["flight_qdrop_cnt"] + drop}
     return n_arr, {**stats, "arrival_cnt": stats["arrival_cnt"] + n_arr}
+
+
+def admitted_wait(stats: dict, free, frank, t):
+    """Per-slot work-queue wait (client arrival -> admission, in ticks)
+    for this tick's admitted lanes, gathered from the flight arrival-tick
+    ring.  Admission drains the queue FIFO, so the lane with admitted
+    rank j takes the txn at global index queue_admit_cnt + j; call
+    BEFORE note_admission moves the head.  Zeros when the recorder is
+    off."""
+    if "arr_flight_qring" not in stats:
+        return jnp.zeros(free.shape[0], jnp.int32)
+    ring = stats["arr_flight_qring"]
+    wait = t - ring[(stats["queue_admit_cnt"] + frank) % ring.shape[0]]
+    return jnp.where(free, jnp.maximum(wait, 0), 0)
 
 
 def note_admission(stats: dict, avail, n_free, measuring) -> dict:

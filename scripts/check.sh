@@ -90,6 +90,29 @@ if [ "$sat_rc" -ne 0 ]; then
     exit "$sat_rc"
 fi
 
+echo "== flight smoke (lifecycle spans + tail attribution) =="
+# the transaction flight recorder (deneva_tpu/obs/flight.py) on one
+# short attributed cell: full-sampling spans must reconcile EXACTLY
+# against the lat_* integrals and abort_* counters (rc=1 on mismatch,
+# ORed with the watchdog bitmask), the run record must export through
+# the unified Perfetto CLI, and the report must render a [tail] section
+flt_dir=$(mktemp -d)
+env JAX_PLATFORMS=cpu python bench.py --flight --algs NO_WAIT \
+    --ticks 40 --no-history --out-dir "$flt_dir"
+flt_rc=$?
+if [ "$flt_rc" -eq 0 ]; then
+    env JAX_PLATFORMS=cpu python -m deneva_tpu.obs.export \
+        "$flt_dir"/run_flight_*.json -o "$flt_dir/flight_trace.json" \
+        && env JAX_PLATFORMS=cpu python -m deneva_tpu.obs.report \
+            "$flt_dir"/run_flight_no_wait.json | grep -q '^\[tail\]'
+    flt_rc=$?
+fi
+rm -rf "$flt_dir"
+if [ "$flt_rc" -ne 0 ]; then
+    echo "flight smoke FAILED (reconcile/export/tail rc=$flt_rc)"
+    exit "$flt_rc"
+fi
+
 echo "== bench regression gate =="
 # gate the latest trajectory point (committed BENCH_r*.json snapshots +
 # any results/bench_history.jsonl) against the median of its priors;

@@ -1,0 +1,230 @@
+"""Transaction flight recorder tests (deneva_tpu/obs/flight.py).
+
+The recorder is an accounting identity, not an estimate — in
+full-sampling mode (every completed txn keeps its span) the summed span
+phases must reconcile EXACTLY against the engine's ``lat_*`` latency
+integrals and the event histogram against the ``abort_*_cnt`` taxonomy
+counters, for every CC plugin.  The off path (``Config.flight=False``,
+the default) must carry zero extra device arrays and leave the
+``[summary]`` line byte-identical; the on path must hold the zero
+post-warmup recompile sentinel.
+"""
+
+import numpy as np
+import pytest
+
+from deneva_tpu.config import Config
+from deneva_tpu.engine.scheduler import Engine
+from deneva_tpu.obs import flight as obs_flight
+from deneva_tpu.obs import trace as obs_trace
+
+BASE = dict(batch_size=64, synth_table_size=1 << 10, req_per_query=4,
+            zipf_theta=0.8, query_pool_size=1 << 10, warmup_ticks=0)
+
+ALGS = ["NO_WAIT", "WAIT_DIE", "TIMESTAMP", "MVCC", "OCC", "MAAT",
+        "CALVIN"]
+
+#: the exact device-array surface the recorder adds (keep in sync with
+#: obs/flight.py init_flight — the off-path purity test asserts the set)
+FLIGHT_STATS_KEYS = {
+    "arr_flight_admit", "arr_flight_facq", "arr_flight_span",
+    "arr_flight_ev", "flight_span_cnt", "flight_ev_cnt",
+    "arr_flight_queue", "arr_flight_proc", "arr_flight_block",
+    "arr_flight_backoff", "arr_flight_net",
+}
+
+
+def flight_cfg(**kw):
+    base = dict(cc_alg="NO_WAIT", flight=True, abort_attribution=True,
+                flight_samples=1 << 14, **BASE)
+    base.update(kw)
+    return Config(**base)
+
+
+def run(cfg, n_ticks=50):
+    eng = Engine(cfg)
+    st = eng.run(n_ticks)
+    return eng, st, eng.summary(st)
+
+
+@pytest.mark.parametrize("alg", ALGS)
+def test_full_sampling_reconciles_exactly(alg):
+    """Σ span phases == lat_* integrals, event hist == abort_*_cnt, and
+    every completed txn kept its span — for every CC plugin."""
+    _, st, s = run(flight_cfg(cc_alg=alg))
+    snap = obs_flight.snapshot(st)
+    assert snap["span_cnt"] > 0
+    assert obs_flight.reconcile(snap, s) == []
+    assert snap["span_cnt"] == s["txn_cnt"] + s["user_abort_cnt"]
+    # user-abort spans are tagged (kind=1), commits kind=0
+    kinds = {d["kind"] for d in snap["spans"]}
+    assert kinds <= {0, 1}
+    assert sum(d["kind"] for d in snap["spans"]) == s["user_abort_cnt"]
+
+
+def test_reconciles_with_warmup():
+    """The phase gate mirrors track_state_latencies' warmup gate, so the
+    identity holds for ANY warmup (events filtered host-side by tick)."""
+    _, st, s = run(flight_cfg(warmup_ticks=15), n_ticks=60)
+    snap = obs_flight.snapshot(st)
+    assert obs_flight.reconcile(snap, s, warmup_ticks=15) == []
+
+
+def test_queue_phase_reconciles_with_arrival():
+    """Open-system runs: Σ span.queue (+ open spans + the still-queued
+    residual) == the Little's-law lat_work_queue_time integral."""
+    cfg = flight_cfg(arrival="poisson", arrival_rate=20.0)
+    n_ticks = 80
+    _, st, s = run(cfg, n_ticks=n_ticks)
+    snap = obs_flight.snapshot(st)
+    assert snap["qdrop_cnt"] == 0
+    # residual: wait already integrated for clients still queued at end
+    ring = np.asarray(st.stats["arr_flight_qring"])
+    qcap = ring.shape[0]
+    head, tail = int(s["queue_admit_cnt"]), int(s["arrival_cnt"])
+    s["flight_queue_residual"] = sum(
+        int(n_ticks - ring[k % qcap]) for k in range(head, tail))
+    assert obs_flight.reconcile(snap, s) == []
+    assert sum(d["queue"] for d in snap["spans"]) > 0
+
+
+def test_sampled_mode_keeps_last_window():
+    """An undersized ring degrades to a sliding window over the MOST
+    RECENT completions — the sampled spans are exactly the tail of the
+    full-sampling run's span list (same seed, same schedule)."""
+    S = 8
+    _, st_full, _ = run(flight_cfg())
+    _, st_small, _ = run(flight_cfg(flight_samples=S))
+    full = obs_flight.snapshot(st_full)
+    small = obs_flight.snapshot(st_small)
+    assert not full["span_wrapped"]
+    assert small["span_wrapped"]
+    assert small["span_cnt"] == full["span_cnt"]    # count still exact
+    assert len(small["spans"]) == S
+    assert small["spans"] == full["spans"][-S:]
+    # reconcile refuses wrapped rings instead of silently passing
+    bad = obs_flight.reconcile(small, {})
+    assert ("span_ring_wrapped", small["span_cnt"], S) in bad
+
+
+@pytest.mark.parametrize("alg", ["NO_WAIT", "MAAT", "CALVIN"])
+def test_flight_off_is_byte_identical_and_carries_nothing(alg):
+    """flight=False (default): zero extra device arrays, zero summary
+    keys; flight=True adds EXACTLY the documented surface."""
+    off_cfg = Config(cc_alg=alg, abort_attribution=True, **BASE)
+    eng_off, st_off, s_off = run(off_cfg, n_ticks=20)
+    assert not any("flight" in k for k in st_off.stats)
+    line = eng_off.summary_line(st_off)
+    assert "flight" not in line
+
+    def engine_bytes(ln):
+        # everything on the line except the host-process utilization keys
+        # (mem_util/cpu_util move with the test harness, not the engine)
+        return ",".join(p for p in ln.split(",")
+                        if not p.startswith(("mem_util=", "cpu_util=")))
+
+    # rerunning the identical config reproduces the line byte for byte
+    eng2, st2, _ = run(off_cfg, n_ticks=20)
+    assert engine_bytes(eng2.summary_line(st2)) == engine_bytes(line)
+
+    _, st_on, s_on = run(flight_cfg(cc_alg=alg), n_ticks=20)
+    extra = set(st_on.stats) - set(st_off.stats)
+    assert extra == FLIGHT_STATS_KEYS
+    # the schedule itself is untouched — same commits, same aborts
+    for k in ("txn_cnt", "total_txn_abort_cnt", "local_txn_start_cnt"):
+        assert s_on[k] == s_off[k], (k, s_on[k], s_off[k])
+    # summary gains only the ring fill counters (arr_ keys are skipped)
+    assert set(s_on) - set(s_off) == {"flight_span_cnt", "flight_ev_cnt"}
+
+
+def test_zero_steady_recompiles_with_flight_on():
+    """The recorder is jit-safe carried state: no shape depends on data,
+    so the xmeter sentinel must count ZERO post-warmup compiles."""
+    cfg = flight_cfg(xmeter=True)
+    eng = Engine(cfg)
+    st = eng.run(12)
+    eng.xmeter.mark_warm()
+    st = eng.run(12, st)
+    assert eng.xmeter.steady_violations() == []
+    assert obs_flight.reconcile(obs_flight.snapshot(st),
+                                eng.summary(st)) == []
+
+
+def test_span_track_schema_and_tail(tmp_path):
+    """Perfetto span track: one X lifecycle slice per span with nested
+    attempt slices (restarts+1) and paired abort-reason flow arrows;
+    to_chrome_trace merges it beside the counter tracks."""
+    cfg = flight_cfg(trace_ticks=40)
+    _, st, s = run(cfg, n_ticks=40)
+    snap = obs_flight.snapshot(st)
+    evs = obs_flight.span_events(snap)
+    top = [e for e in evs if e.get("cat") == "flight"
+           and not e["name"].startswith("attempt")]
+    attempts = [e for e in evs if e.get("cat") == "flight"
+                and e["name"].startswith("attempt")]
+    assert len(top) == len(snap["spans"])
+    flows_s = [e for e in evs if e.get("ph") == "s"]
+    flows_f = [e for e in evs if e.get("ph") == "f"]
+    assert len(flows_s) == len(flows_f)
+    assert all(e["cat"] == "abort-flow" for e in flows_s + flows_f)
+    # each span contributes (abort ticks inside it) + 1 attempt slices
+    assert len(attempts) == len(top) + len(flows_s)
+    for e in top:
+        assert set(e["args"]) == {"facq", "restarts", *obs_flight._ACCS}
+        assert e["ph"] == "X" and e["dur"] >= 1
+
+    path = str(tmp_path / "tr.json")
+    obs_trace.to_chrome_trace(st, path, n_ticks=40, flight=snap)
+    import json
+    doc = json.load(open(path))
+    assert doc["metadata"]["flight_spans"] == len(snap["spans"])
+    assert any(e.get("cat") == "flight" for e in doc["traceEvents"])
+    assert any(e.get("name") == "txn flow" for e in doc["traceEvents"])
+
+    tail = obs_flight.tail_attribution(snap)
+    assert tail["cohort"] >= 1
+    assert tail["dominant_phase"] in obs_flight._ACCS
+    assert abs(sum(tail["phase_share"].values()) - 1.0) < 1e-9
+    assert tail["top_reasons"], "contended cell must abort in the tail"
+
+
+@pytest.mark.slow  # sharded compile cost exceeds the tier-1 budget
+@pytest.mark.parametrize("dly", [0, 2])
+def test_sharded_node_merge_reconciles(dly):
+    """Cluster runs: per-node rings merge on one tick clock, spans carry
+    their node id, and the net phase reconciles against the cluster
+    lat_network_time in BOTH delay modes.  net_delay mode additionally
+    un-hardwires lat_msg_queue_time (the per-message transit integral)."""
+    from deneva_tpu import stats as stats_mod
+    from deneva_tpu.parallel.sharded import ShardedEngine
+    cfg = Config(cc_alg="NO_WAIT", node_cnt=2, part_cnt=2,
+                 net_delay_ticks=dly, flight=True, abort_attribution=True,
+                 flight_samples=1 << 14,
+                 **{**BASE, "batch_size": 32, "zipf_theta": 0.6})
+    eng = ShardedEngine(cfg)
+    st = eng.run(60)
+    s = eng.summary(st)
+    snap = obs_flight.snapshot(st)
+    assert snap["nodes"] == 2
+    assert obs_flight.reconcile(snap, s) == []
+    assert {d["node"] for d in snap["spans"]} == {0, 1}
+    assert sum(d["net"] for d in snap["spans"]
+               + snap["open_spans"]) == s["lat_network_time"]
+    d = stats_mod.reference_summary(s)
+    if dly:
+        assert s["lat_msg_queue_time"] > 0
+        assert d["lat_msg_queue_time"] == s["lat_msg_queue_time"]
+    else:
+        assert "lat_msg_queue_time" not in s
+        assert d["lat_msg_queue_time"] == 0.0
+
+
+def test_msg_queue_time_stays_zero_single_shard():
+    """Satellite contract: single-shard engines carry NO
+    lat_msg_queue_time key and the reference line prints exactly 0.0."""
+    from deneva_tpu import stats as stats_mod
+    _, st, s = run(flight_cfg(), n_ticks=20)
+    assert "lat_msg_queue_time" not in s
+    assert "lat_msg_queue_time" not in st.stats
+    d = stats_mod.reference_summary(s)
+    assert d["lat_msg_queue_time"] == 0.0
