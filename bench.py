@@ -484,12 +484,12 @@ def run_xmeter(args) -> int:
 
 
 def run_single_alg(alg: str, out_dir: str = "results",
-                   history: bool = True):
+                   history: bool = True, fused: bool = False):
     """--alg: the headline YCSB cell (faithful, acquire_window=1) under one
     CC plugin, same one-line JSON shape as the full sweep.  Runs with
     abort attribution on so the cell reports WHY it aborted."""
     per_chip_star = NORTH_STAR_CLUSTER / NORTH_STAR_CHIPS
-    cfg = Config(cc_alg=alg, acquire_window=1,
+    cfg = Config(cc_alg=alg, acquire_window=1, fused_arbitrate=fused,
                  abort_attribution=True, **YCSB_KW)
     tput, cpt, summ = run_cell(cfg)
     doc = {
@@ -507,12 +507,16 @@ def run_single_alg(alg: str, out_dir: str = "results",
         _append_history(doc, cfg, out_dir)
 
 
-def main(out_dir: str = "results", history: bool = True):
+def main(out_dir: str = "results", history: bool = True,
+         fused: bool = False):
+    # --fused flips Config.fused_arbitrate on EVERY cell; the config
+    # fingerprint (obs/profiler.py, dataclasses.asdict) keys the history
+    # line, so fused and lax trajectories never collate into one series
     per_chip_star = NORTH_STAR_CLUSTER / NORTH_STAR_CHIPS
     faithful, _, _ = run_cell(Config(cc_alg="NO_WAIT", acquire_window=1,
-                                     **YCSB_KW))
+                                     fused_arbitrate=fused, **YCSB_KW))
     greedy, _, _ = run_cell(Config(cc_alg="NO_WAIT", acquire_window=10,
-                                   **YCSB_KW))
+                                   fused_arbitrate=fused, **YCSB_KW))
 
     # every algorithm's faithful cell + TPC-C, smaller measurement (the
     # compile dominates; commits/tick is the stable number).  These cells
@@ -523,11 +527,13 @@ def main(out_dir: str = "results", history: bool = True):
     for alg in ("NO_WAIT", "WAIT_DIE", "TIMESTAMP", "MVCC", "OCC", "MAAT",
                 "CALVIN"):
         t, c, summ = run_cell(Config(cc_alg=alg, acquire_window=1,
+                                     fused_arbitrate=fused,
                                      abort_attribution=True, **YCSB_KW),
                               n_ticks=200, windows=3)
         algs[alg] = {"tput": round(t, 1), "commits_per_tick": round(c, 1),
                      **_abort_fields(summ)}
-    t, c, summ = run_cell(Config(abort_attribution=True, **TPCC_KW),
+    t, c, summ = run_cell(Config(abort_attribution=True,
+                                 fused_arbitrate=fused, **TPCC_KW),
                           n_ticks=100, windows=3)
     algs["TPCC_MVCC_64wh"] = {"tput": round(t, 1),
                               "commits_per_tick": round(c, 1),
@@ -547,7 +553,8 @@ def main(out_dir: str = "results", history: bool = True):
     print(json.dumps(doc))
     if history:
         _append_history(doc, Config(cc_alg="NO_WAIT", acquire_window=1,
-                                    **YCSB_KW), out_dir)
+                                    fused_arbitrate=fused, **YCSB_KW),
+                        out_dir)
 
 
 def _cli():
@@ -594,6 +601,12 @@ def _cli():
                    help="compile & memory observatory smoke: recompile "
                         "sentinel + ledger reconcile + roofline "
                         "(exit 1/2 on sentinel/reconcile failure)")
+    p.add_argument("--fused", action="store_true",
+                   help="run the headline cells with the fused VMEM "
+                        "sort+scan arbitration kernel "
+                        "(Config.fused_arbitrate); the config "
+                        "fingerprint keys the history line, so fused "
+                        "runs form their own regression trajectory")
     p.add_argument("--no-history", action="store_true",
                    help="skip the bench_history.jsonl trajectory append "
                         "(headline runs only; obs runs never append)")
@@ -617,6 +630,7 @@ if __name__ == "__main__":
         raise SystemExit(run_obs(_args))
     if _args.alg:
         run_single_alg(_args.alg, out_dir=_args.out_dir,
-                       history=not _args.no_history)
+                       history=not _args.no_history, fused=_args.fused)
     else:
-        main(out_dir=_args.out_dir, history=not _args.no_history)
+        main(out_dir=_args.out_dir, history=not _args.no_history,
+             fused=_args.fused)

@@ -93,7 +93,7 @@ def compact_access(cfg: Config, db: dict, ent: Entries, B: int, R: int,
     i32 = jnp.int32
     conv = tuple(x.astype(i32) if x.dtype == bool else x for x in extras)
     # lint: disable-next=PAD-WIDTH-SORT this IS the compaction-building sort: it must see all n lanes to rank live ones into the prefix
-    sorted_ = lax.sort(
+    sorted_ = seg.sort_pack(
         (keyrank, ent.key, ent.txn, ent.ridx, ent.ts,
          ent.is_write.astype(i32), ent.held.astype(i32),
          ent.req.astype(i32)) + conv,

@@ -317,46 +317,13 @@ class Maat(CCPlugin):
         # my (key, txn)-run start: same txn's entries on one key share ts
         run_start3 = st3 | (t3 != jnp.roll(t3, 1))
         M = max(int(cfg.maat_chain_window), 1)
-        # jnp.roll wraps: lane i < d would pair with lane nK-d+i (the
-        # ARRAY's tail, not a chain predecessor) whenever one key's run
-        # spans the whole array — degenerate single-key workloads hit
-        # this.  The key-equality guard normally breaks cross-key wraps
-        # but not same-key ones; mask the wrapped lanes explicitly.
-        lane = jnp.arange(nK, dtype=jnp.int32)
-
-        # The pair window's STATIC classification is bit-packed — 2 bits
-        # per distance d — into one int32 lane array: 0 = no pair,
-        # 1 = concordant P-writer, 2 = concordant P-reader,
-        # 3 = discordant.  Materializing the ~7 boolean masks per
-        # distance instead made XLA hoist ~50 pred[B*R] arrays into the
-        # fixed-point while carry (a scoped-memory copy storm measured at
-        # several ms/tick on TPU); the packed word keeps the carry small
-        # and the per-step unpack is a free elementwise shift.
-        wcode = jnp.zeros(nK, jnp.int32)
-        for d in range(1, min(M, 16)):
-            pair_s = (fin3 & iw3 & jnp.roll(fin3, d) & (lane >= d)
-                      & (jnp.roll(k3, d) == k3)
-                      & (jnp.roll(t3, d) != t3))
-            conc_s = jnp.roll(at3, d) <= at3
-            cls = jnp.where(
-                pair_s,
-                jnp.where(conc_s,
-                          jnp.where(jnp.roll(iw3, d), 1, 2), 3), 0)
-            wcode = wcode | (cls << (2 * (d - 1)))
-        # distances past 15 cannot pack into 2-bit lanes of one word;
-        # carry their masks directly (parity harnesses with W=64 trade
-        # carry size for exactness)
-        far = []
-        for d in range(16, M):
-            pair_s = (fin3 & iw3 & jnp.roll(fin3, d) & (lane >= d)
-                      & (jnp.roll(k3, d) == k3)
-                      & (jnp.roll(t3, d) != t3))
-            conc_s = jnp.roll(at3, d) <= at3
-            far.append(jnp.where(
-                pair_s,
-                jnp.where(conc_s,
-                          jnp.where(jnp.roll(iw3, d), 1, 2), 3), 0)
-                .astype(jnp.int8))
+        # distinct finishing VALIDATORS per row segment (one (key, txn)
+        # run each, run_start3 — a txn with several finishing entries on
+        # one row is still one validator): drives both the overflow
+        # counter below and the chain gate — a pairwise pusher/target
+        # pair needs at least two of them on one row
+        nfin_seg = seg.seg_reduce((run_start3 & fin3).astype(jnp.int32),
+                                  st3, "sum")
 
         def to_chain(*vals_B):
             """Broadcast per-txn (B,) values to the compacted lanes (a
@@ -371,69 +338,11 @@ class Maat(CCPlugin):
             txn's run, and only per-txn-constant payloads may ship
             through it."""
             pay = tuple(lane_of(v).astype(jnp.int32) for v in vals_B)
-            out = jax.lax.sort((key, nf, ts) + pay, num_keys=3,
-                               is_stable=False)
+            out = seg.sort_pack((key, nf, ts) + pay, num_keys=3,
+                                is_stable=False)
             return out[3:]
 
-        def caps(okv, lov, upv):
-            s_ok, s_lo, s_up = to_chain(okv, lov, upv)
-            okf = (s_ok == 1) & fin3
-            # READER targets: every ok earlier validator that wrote the
-            # row caps my upper to its lower-1 in BOTH access orders (the
-            # before-push and the forward-val push coincide), so the cap
-            # is an exact ts-prefix scan at any multiplicity, excluding
-            # my own entries via the run-start trick.
-            pmw_full = seg.seg_prefix_min(
-                jnp.where(okf & iw3, dn1(s_lo), BIG_TS), st3, BIG_TS)
-            pmw = seg.at_run_start(pmw_full, run_start3, st3, BIG_TS,
-                                   "min")
-            cap_e = jnp.where(fin3 & ~iw3, pmw, BIG_TS)
-            # WRITER targets: direction depends on per-row access order ->
-            # consult the nearest M-1 earlier validators pairwise.
-            #   accessed before P (discordant, I am in P's after set):
-            #     lower >= P.upper+1 — but P's upper first DUCKS under my
-            #     range when it can (maat.cpp:145-152: my upper-2 if
-            #     finite and in range, my lower-1 if my lower clears
-            #     P.lower+1), which usually turns the push into a no-op;
-            #     the duck is applied pair-locally here.
-            #   accessed after P (concordant, P is in MY sets): single-
-            #     shard, P committed+released before I validate, so its
-            #     commit-time forward validation applies (P wrote ->
-            #     upper <= P.lo-1; P read -> lower >= P.lo+1).  Sharded,
-            #     P sits in its 2PC prepare window still VALIDATED in the
-            #     owner's TimeTable, so cases 4/5 apply instead: lower >=
-            #     P.upper+1, raw (no duck — P is not at its own
-            #     validation); P's commit-direction pushes happen at the
-            #     commit exchange (commit_forward_entries) like the
-            #     reference's RFIN.
-            push_e = jnp.zeros_like(cap_e)
-            for d in range(1, M):
-                if d < 16:
-                    cls = (wcode >> (2 * (d - 1))) & 3
-                else:
-                    cls = far[d - 16].astype(jnp.int32)
-                cls = jnp.where(jnp.roll(okf, d) & (lane >= d), cls, 0)
-                p_lo = jnp.roll(s_lo, d)
-                p_up = jnp.roll(s_up, d)
-                c1 = jnp.where((s_up < BIG_TS) & (s_up > p_lo + 2)
-                               & (s_up < p_up), s_up - 2, BIG_TS)
-                c2 = jnp.where((s_lo > p_lo + 1) & (s_lo < p_up),
-                               s_lo - 1, BIG_TS)
-                p_up_eff = jnp.minimum(p_up, jnp.minimum(c1, c2))
-                if cfg.node_cnt > 1:
-                    push_d = jnp.where(cls == 3, up1(p_up_eff),
-                                       jnp.where(cls > 0, up1(p_up), 0))
-                else:
-                    cap_e = jnp.minimum(
-                        cap_e, jnp.where(cls == 1, dn1(p_lo), BIG_TS))
-                    push_d = jnp.where(
-                        cls == 2, up1(p_lo),
-                        jnp.where(cls == 3, up1(p_up_eff), 0))
-                push_e = jnp.maximum(push_e, push_d)
-            # per-txn combine straight from chain order (commutative
-            # scatter — replaces the old unpermute sort + (B, R) reshape)
-            upper_new = txn_min(tx3, cap_e, upper0)
-            lower_new = txn_max(tx3, push_e, static_lower)
+        def group_combine(lower_new, upper_new):
             if R == 1 and cfg.node_cnt > 1:
                 # sharded virtual-entry context: the reference keeps ONE
                 # TimeTable record per (node, txn) — a push received on
@@ -450,38 +359,174 @@ class Maat(CCPlugin):
                 lower_new, upper_new = seg.unpermute_many(gidx, glo, gup)
             return lower_new, upper_new
 
-        def step(carry):
-            okv, lov, upv, _ = carry
-            lower_new, upper_new = caps(okv, lov, upv)
-            new_ok = ok_allowed & (lower_new < upper_new)
-            changed = (jnp.any(new_ok != okv) | jnp.any(lower_new != lov)
-                       | jnp.any(upper_new != upv))
-            return new_ok, lower_new, upper_new, changed
+        # ---- chain gate (the BENCH_r05 W=8 recovery): the pairwise
+        # window and its fixed-point loop only matter when some row-tick
+        # has >= 2 distinct finishing validators — with at most one, the
+        # reader cap's run-start exclusion leaves pmw = BIG_TS and no
+        # pair_s fires (a pair needs two fin3 runs with distinct ts on
+        # one key), so caps() degenerates to cap_e = BIG_TS / push_e = 0
+        # and one step reproduces its inputs.  The skip branch below IS
+        # exactly that degenerate output: base bounds + the same group
+        # combine.  Both branches trace once at compile, so the cond is
+        # jit-safe with zero post-warm recompiles (tests/test_fused.py).
+        chain_needed = jnp.any(st3 & (nfin_seg > 1))
 
-        # SPECULATIVE UNROLL (PROFILE.md): the ts-ordered chain usually
-        # settles in <= 2 iterations; unrolled steps fuse into the tick
-        # graph (no while-carry scoped-memory round trips) and the loop
-        # runs only for genuinely deeper chains.  `upper` rides the carry,
-        # so no extra caps() pass is needed after convergence: the loop
-        # exits exactly when a step reproduces its inputs.
-        ok, lower, upper, ch = step((ok_allowed, static_lower, upper0,
-                                     jnp.any(finishing) | True))
-        ok, lower, upper, ch = step((ok, lower, upper, ch))
+        def chain_branch(_):
+            # jnp.roll wraps: lane i < d would pair with lane nK-d+i (the
+            # ARRAY's tail, not a chain predecessor) whenever one key's
+            # run spans the whole array — degenerate single-key workloads
+            # hit this.  The key-equality guard normally breaks cross-key
+            # wraps but not same-key ones; mask the wrapped lanes
+            # explicitly.
+            lane = jnp.arange(nK, dtype=jnp.int32)
 
-        def bounded_step(c):
-            okv, lov, upv, chv, it = c
-            okv, lov, upv, chv = step((okv, lov, upv, chv))
-            return okv, lov, upv, chv, it + 1
+            # The pair window's STATIC classification is bit-packed — 2
+            # bits per distance d — into one int32 lane array: 0 = no
+            # pair, 1 = concordant P-writer, 2 = concordant P-reader,
+            # 3 = discordant.  Materializing the ~7 boolean masks per
+            # distance instead made XLA hoist ~50 pred[B*R] arrays into
+            # the fixed-point while carry (a scoped-memory copy storm
+            # measured at several ms/tick on TPU); the packed word keeps
+            # the carry small and the per-step unpack is a free
+            # elementwise shift.
+            wcode = jnp.zeros(nK, jnp.int32)
+            for d in range(1, min(M, 16)):
+                pair_s = (fin3 & iw3 & jnp.roll(fin3, d) & (lane >= d)
+                          & (jnp.roll(k3, d) == k3)
+                          & (jnp.roll(t3, d) != t3))
+                conc_s = jnp.roll(at3, d) <= at3
+                cls = jnp.where(
+                    pair_s,
+                    jnp.where(conc_s,
+                              jnp.where(jnp.roll(iw3, d), 1, 2), 3), 0)
+                wcode = wcode | (cls << (2 * (d - 1)))
+            # distances past 15 cannot pack into 2-bit lanes of one word;
+            # carry their masks directly (parity harnesses with W=64
+            # trade carry size for exactness)
+            far = []
+            for d in range(16, M):
+                pair_s = (fin3 & iw3 & jnp.roll(fin3, d) & (lane >= d)
+                          & (jnp.roll(k3, d) == k3)
+                          & (jnp.roll(t3, d) != t3))
+                conc_s = jnp.roll(at3, d) <= at3
+                far.append(jnp.where(
+                    pair_s,
+                    jnp.where(conc_s,
+                              jnp.where(jnp.roll(iw3, d), 1, 2), 3), 0)
+                    .astype(jnp.int8))
 
-        # iteration safety bound: the chain's ok-retraction makes it
-        # non-monotone in theory; 64 ranks resolve any chain seen in
-        # practice and a pathological cycle exits instead of hanging
-        ok, lower, upper, _, _ = jax.lax.cond(
-            ch,
-            lambda op: jax.lax.while_loop(
-                lambda c: c[3] & (c[4] < 64), bounded_step, op),
-            lambda op: op,
-            (ok, lower, upper, ch, jnp.zeros((), jnp.int32)))
+            def caps(okv, lov, upv):
+                s_ok, s_lo, s_up = to_chain(okv, lov, upv)
+                okf = (s_ok == 1) & fin3
+                # READER targets: every ok earlier validator that wrote
+                # the row caps my upper to its lower-1 in BOTH access
+                # orders (the before-push and the forward-val push
+                # coincide), so the cap is an exact ts-prefix scan at any
+                # multiplicity, excluding my own entries via the
+                # run-start trick.
+                pmw_full = seg.seg_prefix_min(
+                    jnp.where(okf & iw3, dn1(s_lo), BIG_TS), st3, BIG_TS)
+                pmw = seg.at_run_start(pmw_full, run_start3, st3, BIG_TS,
+                                       "min")
+                cap_e = jnp.where(fin3 & ~iw3, pmw, BIG_TS)
+                # WRITER targets: direction depends on per-row access
+                # order -> consult the nearest M-1 earlier validators
+                # pairwise.
+                #   accessed before P (discordant, I am in P's after
+                #     set): lower >= P.upper+1 — but P's upper first
+                #     DUCKS under my range when it can (maat.cpp:145-152:
+                #     my upper-2 if finite and in range, my lower-1 if my
+                #     lower clears P.lower+1), which usually turns the
+                #     push into a no-op; the duck is applied pair-locally
+                #     here.
+                #   accessed after P (concordant, P is in MY sets):
+                #     single-shard, P committed+released before I
+                #     validate, so its commit-time forward validation
+                #     applies (P wrote -> upper <= P.lo-1; P read ->
+                #     lower >= P.lo+1).  Sharded, P sits in its 2PC
+                #     prepare window still VALIDATED in the owner's
+                #     TimeTable, so cases 4/5 apply instead: lower >=
+                #     P.upper+1, raw (no duck — P is not at its own
+                #     validation); P's commit-direction pushes happen at
+                #     the commit exchange (commit_forward_entries) like
+                #     the reference's RFIN.
+                push_e = jnp.zeros_like(cap_e)
+                for d in range(1, M):
+                    if d < 16:
+                        cls = (wcode >> (2 * (d - 1))) & 3
+                    else:
+                        cls = far[d - 16].astype(jnp.int32)
+                    cls = jnp.where(jnp.roll(okf, d) & (lane >= d), cls,
+                                    0)
+                    p_lo = jnp.roll(s_lo, d)
+                    p_up = jnp.roll(s_up, d)
+                    c1 = jnp.where((s_up < BIG_TS) & (s_up > p_lo + 2)
+                                   & (s_up < p_up), s_up - 2, BIG_TS)
+                    c2 = jnp.where((s_lo > p_lo + 1) & (s_lo < p_up),
+                                   s_lo - 1, BIG_TS)
+                    p_up_eff = jnp.minimum(p_up, jnp.minimum(c1, c2))
+                    if cfg.node_cnt > 1:
+                        push_d = jnp.where(cls == 3, up1(p_up_eff),
+                                           jnp.where(cls > 0, up1(p_up),
+                                                     0))
+                    else:
+                        cap_e = jnp.minimum(
+                            cap_e, jnp.where(cls == 1, dn1(p_lo), BIG_TS))
+                        push_d = jnp.where(
+                            cls == 2, up1(p_lo),
+                            jnp.where(cls == 3, up1(p_up_eff), 0))
+                    push_e = jnp.maximum(push_e, push_d)
+                # per-txn combine straight from chain order (commutative
+                # scatter — replaces the old unpermute sort + (B, R)
+                # reshape)
+                upper_new = txn_min(tx3, cap_e, upper0)
+                lower_new = txn_max(tx3, push_e, static_lower)
+                return group_combine(lower_new, upper_new)
+
+            def step(carry):
+                okv, lov, upv, _ = carry
+                lower_new, upper_new = caps(okv, lov, upv)
+                new_ok = ok_allowed & (lower_new < upper_new)
+                changed = (jnp.any(new_ok != okv)
+                           | jnp.any(lower_new != lov)
+                           | jnp.any(upper_new != upv))
+                return new_ok, lower_new, upper_new, changed
+
+            # SPECULATIVE UNROLL (PROFILE.md): the ts-ordered chain
+            # usually settles in <= 2 iterations; unrolled steps fuse
+            # into the tick graph (no while-carry scoped-memory round
+            # trips) and the loop runs only for genuinely deeper chains.
+            # `upper` rides the carry, so no extra caps() pass is needed
+            # after convergence: the loop exits exactly when a step
+            # reproduces its inputs.
+            ok, lower, upper, ch = step((ok_allowed, static_lower,
+                                         upper0,
+                                         jnp.any(finishing) | True))
+            ok, lower, upper, ch = step((ok, lower, upper, ch))
+
+            def bounded_step(c):
+                okv, lov, upv, chv, it = c
+                okv, lov, upv, chv = step((okv, lov, upv, chv))
+                return okv, lov, upv, chv, it + 1
+
+            # iteration safety bound: the chain's ok-retraction makes it
+            # non-monotone in theory; 64 ranks resolve any chain seen in
+            # practice and a pathological cycle exits instead of hanging
+            ok, lower, upper, _, _ = jax.lax.cond(
+                ch,
+                lambda op: jax.lax.while_loop(
+                    lambda c: c[3] & (c[4] < 64), bounded_step, op),
+                lambda op: op,
+                (ok, lower, upper, ch, jnp.zeros((), jnp.int32)))
+            return ok, lower, upper
+
+        def skip_branch(_):
+            # the chain's exact degenerate output (see gate comment)
+            lower_f, upper_f = group_combine(static_lower, upper0)
+            return ok_allowed & (lower_f < upper_f), lower_f, upper_f
+
+        ok, lower, upper = jax.lax.cond(chain_needed, chain_branch,
+                                        skip_branch, jnp.int32(0))
 
         # counters: maat_case1/3 are the reference families (snapshot
         # pushes, maat.cpp:46-48,68-70); the chain/abort counters are
@@ -503,11 +548,8 @@ class Maat(CCPlugin):
         cnt = lambda m: jnp.where(measuring,
                                   jnp.sum((m & rep).astype(jnp.int32)), 0)
         # row-ticks whose validator count exceeds the pair window (their
-        # farthest writer-target pairs were dropped).  Count distinct
-        # VALIDATORS (one (key, txn) run each, run_start3) — a txn with
-        # several finishing entries on one row is still one validator
-        nfin_seg = seg.seg_reduce((run_start3 & fin3).astype(jnp.int32),
-                                  st3, "sum")
+        # farthest writer-target pairs were dropped; nfin_seg is the
+        # distinct-validator count computed for the chain gate above)
         ovf = jnp.where(measuring & (M < B),
                         jnp.sum((st3 & (nfin_seg > M)).astype(jnp.int32)),
                         0)
@@ -586,7 +628,7 @@ class Maat(CCPlugin):
                                             lower_v + 1), upper)
         # re-sort shipping of BOTH ducked bounds (same precondition as
         # to_chain: ts unique per txn, payload per-txn-constant)
-        _, _, _, up2c, lo2c = jax.lax.sort(
+        _, _, _, up2c, lo2c = seg.sort_pack(
             (key, atick, ts, lane_of(upper_v), lane_of(lower_v)),
             num_keys=3, is_stable=False)
 

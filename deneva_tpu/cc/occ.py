@@ -233,8 +233,8 @@ class Occ(CCPlugin):
             def group_and(ok_e):
                 bad = (finishing & ~ok_e).astype(jnp.int32)
                 # lint: disable-next=PAD-WIDTH-SORT same (B,)-wide per-txn ts-group reduction as above: re-sorts on the fixed group keys
-                _, _, s_bad = jax.lax.sort((gkey, gord, bad), num_keys=2,
-                                           is_stable=False)
+                _, _, s_bad = seg.sort_pack((gkey, gord, bad), num_keys=2,
+                                            is_stable=False)
                 g_bad = seg.seg_reduce(s_bad, gstarts, "max")
                 return finishing & seg.unpermute(g_orig, g_bad == 0)
         else:
@@ -248,7 +248,7 @@ class Occ(CCPlugin):
             # replaces, PROFILE.md); compaction preserves txn-major order
             # so valid[tx] stays a monotone gather
             valid_e = valid[jnp.clip(tx, 0, B - 1)]
-            _, _, s_valid = jax.lax.sort(
+            _, _, s_valid = seg.sort_pack(
                 (key, ts, valid_e.astype(jnp.int32)), num_keys=2,
                 is_stable=False)
             blocking = live & s_iw & (s_valid == 1)

@@ -65,8 +65,8 @@ def arbitrate(ent: Entries, policy: str):
                | (ent.held.astype(jnp.int32) << (_IDX_BITS + 1))
                | (ent.req.astype(jnp.int32) << (_IDX_BITS + 2)))
 
-    skk, sts, spay = lax.sort((keykind, ent.ts, payload), num_keys=2,
-                              is_stable=False)
+    skk, sts, spay = seg.sort_pack((keykind, ent.ts, payload), num_keys=2,
+                                   is_stable=False)
     s_iw = (spay >> _IDX_BITS) & 1 == 1
     s_held = (spay >> (_IDX_BITS + 1)) & 1 == 1
     s_req = (spay >> (_IDX_BITS + 2)) & 1 == 1
@@ -218,8 +218,8 @@ def arbitrate_window(txn, active, policy: str, tmp: dict,
     tsw = jnp.broadcast_to(ts[:, None], (B, W)).reshape(-1)
     payload = (jnp.arange(n, dtype=jnp.int32)
                | (riw.reshape(-1).astype(jnp.int32) << _IDX_BITS))
-    srow, sts, spay = lax.sort((rrow, tsw, payload), num_keys=2,
-                               is_stable=False)
+    srow, sts, spay = seg.sort_pack((rrow, tsw, payload), num_keys=2,
+                                    is_stable=False)
     s_iw = (spay >> _IDX_BITS) & 1 == 1
     s_idx = spay & _IDX_MASK
     s_live = srow != NULL_KEY

@@ -246,6 +246,31 @@ class Config:
     #: harnesses raise it; 8 covers >99% of row-ticks at paper skews.
     maat_chain_window: int = 8
 
+    #: run every eligible arbitration sort through the fused Pallas
+    #: bitonic-sort+segmented-scan kernel (ops/fused.py) instead of
+    #: standalone ``lax.sort`` ops: one sort->scan stage executes
+    #: entirely in VMEM at the compacted width K (PROFILE.md round 7,
+    #: ROADMAP open item #1).  Decisions are bit-identical to the
+    #: ``lax.sort`` path — the kernel appends the lane index as a final
+    #: tiebreak key, realizing exactly the stable lexicographic order
+    #: ``lax.sort(is_stable=True)`` produces — so [summary] lines match
+    #: byte-for-byte (tests/test_fused.py).  Off by default: the lax
+    #: path stays the reference schedule and the flag lands in the
+    #: config fingerprint automatically (obs/profiler.py), keeping
+    #: bench_history.jsonl rows comparable.  On CPU the kernel runs in
+    #: Pallas interpret mode, so tier-1 and all equivalence tests work
+    #: without a TPU.
+    fused_arbitrate: bool = False
+    #: VMEM-capacity guard for the fused kernel: a sort whose
+    #: padded-to-pow2 width exceeds this lane count (or whose operand
+    #: bytes exceed the hard VMEM budget in ops/fused.py) falls back to
+    #: ``lax.sort`` STATICALLY and LOUDLY — the event is recorded in the
+    #: trace-time fallback registry and surfaces in run records
+    #: (obs/profiler.py), never a silent wrong answer.  8192 lanes keeps
+    #: every compacted-width chain fused while excluding the full-width
+    #: B*R compaction builds at headline geometry.
+    fused_max_lanes: int = 8192
+
     # --- logging / replication (reference config.h:147 LOGGING,
     # :24-27 REPLICA_CNT; system/logger.cpp, worker_thread.cpp:527-554) ---
     logging: bool = False        # command log gating commit (off by default,

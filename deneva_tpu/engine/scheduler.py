@@ -39,6 +39,7 @@ from deneva_tpu.obs import trace as obs_trace
 from deneva_tpu.obs.prog import ProgressEmitter
 from deneva_tpu.obs.profiler import PhaseProfiler
 from deneva_tpu.obs.xmeter import XMeter, ledger_totals, state_ledger
+from deneva_tpu.ops import segment as seg
 from deneva_tpu.engine.state import (
     NULL_KEY, STATUS_BACKOFF, STATUS_FREE, STATUS_RUNNING, STATUS_WAITING,
     TxnState,
@@ -901,7 +902,20 @@ def make_tick(cfg: Config, plugin, pool_dev: dict, workload=None):
                            pool_cursor=(state.pool_cursor + n_free) % Q,
                            ts_counter=ts_counter)
 
-    return tick_fn
+    if not cfg.fused_arbitrate:
+        return tick_fn
+
+    # fused-arbitration dispatch (ops/fused.py): entering the scope while
+    # jit TRACES the tick flips ops/segment.py's sort_pack to the VMEM
+    # kernel for every eligible sort in the body — a Python-level static
+    # switch, so the default-off trace is untouched and nothing leaks
+    # into other engines' traces
+    # lint: kernel
+    def tick_fused(state: EngineState) -> EngineState:
+        with seg.fused_scope(cfg):
+            return tick_fn(state)
+
+    return tick_fused
 
 
 class Engine:

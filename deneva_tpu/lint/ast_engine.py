@@ -52,6 +52,10 @@ WRAPPERS = frozenset({
     "jax.lax.cond", "jax.lax.while_loop", "jax.lax.scan",
     "jax.lax.fori_loop", "jax.lax.switch", "jax.lax.map",
     "jax.lax.associative_scan", "jax.eval_shape", "jax.make_jaxpr",
+    # Pallas kernel bodies (ops/fused.py): the callable handed to
+    # pallas_call is traced on device like any jit entry, so the kernel
+    # rules (SCATTER-RACE, TRACED-BRANCH, PAD-WIDTH-SORT, ...) apply
+    "jax.experimental.pallas.pallas_call", "pallas_call",
 })
 
 #: .at[idx].OP combines that are order-independent under duplicate indices
@@ -456,6 +460,14 @@ class KernelChecker(ast.NodeVisitor):
                 and all(isinstance(op, (ast.In, ast.NotIn))
                         for op in test.ops) \
                 and isinstance(test.left, ast.Constant):
+            return
+        # `x is None` / `x is not None` is an identity test: `is` never
+        # calls bool() on its operands and yields a host bool even when
+        # the name is elsewhere bound to a traced value (the
+        # default-argument idiom in ops/fused.py)
+        if isinstance(test, ast.Compare) \
+                and all(isinstance(op, (ast.Is, ast.IsNot))
+                        for op in test.ops):
             return
         if self._is_jax_call(test):
             kind = type(node).__name__.lower()

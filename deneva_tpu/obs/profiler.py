@@ -132,6 +132,14 @@ def run_record(cfg, summary: dict, phases: Optional[dict] = None,
         "profile": _jsonable(phases) if phases else None,
         "timeline": _jsonable(timeline) if timeline else None,
     }
+    if getattr(cfg, "fused_arbitrate", False):
+        # the fused kernel's loud static-fallback accounting
+        # (ops/fused.py): any sort that fell back to lax.sort at trace
+        # time is on the record, never silent.  Kept out of [summary] —
+        # the fused path's summary lines must stay bit-identical to the
+        # lax path's (tests/test_fused.py).
+        from deneva_tpu.ops import fused
+        rec["fused_fallbacks"] = fused.fallback_snapshot()
     if extra:
         rec.update(_jsonable(extra))
     return rec

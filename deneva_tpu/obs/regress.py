@@ -17,6 +17,12 @@ bench.py runs — and gates two families:
   clock).  Default ``--cpt-tolerance 0.15``: a 20% drop in any
   algorithm's cell fails the gate.
 
+- **required cells** — a headline point must still CARRY the sort-bound
+  cells the optimization rounds guard (``REQUIRED_CELLS``: MAAT, MVCC,
+  OCC, TPCC_MVCC_64wh) once any prior headline point has; a cell that
+  silently vanishes from the sweep would otherwise evade its
+  commits_per_tick gate.
+
 Open-system sweep records (bench.py ``--offered-load``) join the same
 trajectory under their own ``offered_load_knee`` metric and
 ``<ALG>@knee`` cells; their per-algorithm saturation knee is gated like
@@ -41,6 +47,15 @@ DEFAULT_HEADLINE_TOL = 0.5
 DEFAULT_CPT_TOL = 0.15
 
 HISTORY_BASENAME = "bench_history.jsonl"
+
+# the sort-bound cells the round-5/round-7 work optimizes (compaction,
+# then the fused arbitration kernel): driver-visible numbers that must
+# not silently VANISH from the headline sweep — a dropped cell would
+# evade the commits_per_tick gate entirely.  Enforced only on headline
+# points, and self-arming: a cell is required once any prior headline
+# point carried it.
+HEADLINE_METRIC = "ycsb_nowait_zipf0.6_tput_faithful"
+REQUIRED_CELLS = ("MAAT", "MVCC", "OCC", "TPCC_MVCC_64wh")
 
 
 # ---------------------------------------------------------------------------
@@ -183,6 +198,21 @@ def gate(entries: list[dict], current: Optional[dict] = None,
         check(f"commits_per_tick[{alg}]", cur,
               [e["algs"][alg] for e in prior if alg in e["algs"]],
               cpt_tolerance)
+    if current.get("metric") == HEADLINE_METRIC:
+        for alg in REQUIRED_CELLS:
+            if alg in current["algs"]:
+                continue
+            seen = sum(1 for e in prior
+                       if e["metric"] == HEADLINE_METRIC
+                       and alg in e["algs"])
+            if seen:
+                failures.append(
+                    f"required cell commits_per_tick[{alg}] missing "
+                    f"from the current headline point ({seen} prior "
+                    "point(s) carried it)")
+            else:
+                skipped.append(f"required cell {alg}: no prior data "
+                               "(requirement arms once it appears)")
     # saturation-knee trajectory (--offered-load records): an
     # algorithm's knee collapsing means it saturates at a lower offered
     # rate than it used to — the same schedule-pure gate as
@@ -207,6 +237,10 @@ def render_text(result: dict) -> str:
         lines.append(f"  {'OK  ' if c['ok'] else 'FAIL'} {c['name']}: "
                      f"{c['current']:g} vs median {c['median']:g} "
                      f"(floor {c['floor']:g}, n={c['n_prior']})")
+    # failures without a numeric check row (the required-cell rule)
+    for f in result["failures"]:
+        if f.startswith("required cell"):
+            lines.append(f"  FAIL {f}")
     for s in result["skipped"]:
         lines.append(f"  skip {s}")
     n = len(result["failures"])

@@ -1,0 +1,240 @@
+"""Fused bitonic-sort + segmented-scan Pallas kernel (ROADMAP item #1).
+
+PROFILE.md's in-engine HLO traces show the arbitration floor is
+SORT-bound: the post-sort segment scans fuse into cheap VPU passes while
+every standalone ``lax.sort`` at entry width costs 0.3-1.0 ms — and
+MAAT's validate runs ~17 of them per tick.  PR 3's live-entry compaction
+shrank the sort width to a config-derived K that fits VMEM, which is
+exactly the precondition for fusing the sort ITSELF with the scans: one
+``pallas_call`` loads the K-lane operand pack into VMEM once, runs the
+whole multi-operand bitonic network there, computes the segment-start
+mask and the segmented start-index cummax in the same kernel, and writes
+everything back — no HBM round trip between the sort and its scans.
+
+Correctness contract (tests/test_fused.py):
+
+- the network appends the LANE INDEX as a final tiebreak key, so its
+  output realizes exactly the unique stable lexicographic order that
+  ``lax.sort(..., is_stable=True)`` produces — bit-identical sorted
+  operands, hence bit-identical ``[summary]`` lines.  Unstable call
+  sites (``unpermute``'s all-distinct permutation keys, the documented
+  tie-invariant payloads of ``to_chain``-style re-sorts) accept any
+  valid sort order, and a stable one is valid;
+- lanes are padded to the next power of two with ``INT32_MAX`` keys;
+  because every real lane's index precedes every pad lane's, the first
+  n output lanes are exactly the sorted real lanes even when real keys
+  equal the sentinel (NULL_KEY rows);
+- on CPU the kernel runs in Pallas ``interpret`` mode (the kernel jaxpr
+  inlines into the surrounding XLA computation), so tier-1 and all
+  equivalence tests run without a TPU.
+
+Capacity discipline: a sort that would not fit the VMEM budget —
+``Config.fused_max_lanes`` or the hard byte budget below — falls back to
+``lax.sort`` STATICALLY and LOUDLY: the event lands in the trace-time
+fallback registry (surfaced through run records, obs/profiler.py) and
+warns once per distinct site shape.  Never a silent wrong answer.
+
+Layout note for the compiled TPU path: operands ride as flat (P,) int32
+lanes and the compare-exchange stages are reshape-based (partner lanes
+at stride j sit in adjacent halves of a (P/2j, 2, j) view), so stages
+with j < 128 pay lane-crossing relayouts.  A sublane-tiled variant that
+keeps the pack (8, 128)-resident is the known follow-up; the structural
+win measured in PROFILE.md round 7 — standalone sort ops leaving the
+tick HLO — is independent of it.
+"""
+
+from __future__ import annotations
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU memory-space constructors; absent on CPU-only builds is fine
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover - CPU image always ships it
+    pltpu = None
+
+_INT32_MAX = 2**31 - 1
+
+#: hard VMEM byte budget for one fused sort: inputs + outputs + the lane
+#: index column must co-reside (~half of a 16 MB v5e VMEM, leaving the
+#: compiler headroom for double buffering)
+VMEM_BUDGET_BYTES = 8 << 20
+
+#: operand-count ceiling: MAAT's widest chain sort packs 10 operands;
+#: anything past this is an unexpected call shape, not an arbitration
+MAX_OPERANDS = 24
+
+
+# ---------------------------------------------------------------------------
+# trace-time fallback registry — the "loud, never silent" accounting
+# ---------------------------------------------------------------------------
+
+#: every ineligible dispatch observed at TRACE time (static per compile,
+#: one entry per call site x reason, with a hit count)
+_FALLBACKS: dict = {}
+
+
+def record_fallback(width: int, n_operands: int, reason: str) -> None:
+    key = (width, n_operands, reason)
+    if key not in _FALLBACKS:
+        _FALLBACKS[key] = 0
+        warnings.warn(
+            f"fused_sort_scan fallback to lax.sort: width={width} "
+            f"operands={n_operands} reason={reason} (static, counted in "
+            "the run record)", stacklevel=3)
+    _FALLBACKS[key] += 1
+
+
+def fallback_snapshot() -> dict:
+    """Aggregated registry for run records: process-global, trace-time
+    (each entry counts TRACES that fell back, not ticks — the decision
+    is static per compile)."""
+    events = [{"width": w, "operands": n, "reason": r, "traces": c}
+              for (w, n, r), c in sorted(_FALLBACKS.items())]
+    return {"count": int(sum(e["traces"] for e in events)),
+            "events": events}
+
+
+def reset_fallbacks() -> None:
+    _FALLBACKS.clear()
+
+
+# ---------------------------------------------------------------------------
+# the kernel
+# ---------------------------------------------------------------------------
+
+
+def _lex_gt(a_keys, b_keys):
+    """Lexicographic a > b over parallel key columns.  The final column
+    is the all-distinct lane index, so the order is total and the
+    comparator never leaves an undecided tie."""
+    gt = jnp.zeros(a_keys[0].shape, jnp.bool_)
+    eq = jnp.ones(a_keys[0].shape, jnp.bool_)
+    for a, b in zip(a_keys, b_keys):
+        gt = gt | (eq & (a > b))
+        eq = eq & (a == b)
+    return gt
+
+
+def _pallas_sort_scan(padded, num_keys: int, P: int, interpret: bool):
+    """One pallas_call over the padded (P,) int32 pack: bitonic sort by
+    (operands[:num_keys], lane index), then in-kernel segment starts on
+    the primary key and the segmented start-index cummax."""
+    n_in = len(padded)
+
+    def fused_sort_scan_kernel(*refs):
+        ins, outs = refs[:n_in], refs[n_in:]
+        cols = [r[:] for r in ins]
+        # TPU iota must be >=2D (pallas guide); squeeze back to lanes
+        lane0 = jax.lax.broadcasted_iota(jnp.int32, (P, 1), 0)[:, 0]
+        cols.append(lane0)          # final tiebreak key -> stable order
+
+        # bitonic network: merge size k doubles, compare stride j halves.
+        # Partners at stride j are the two halves of a (P/2j, 2, j) view
+        # (partner = lane ^ j), so every exchange is reshape + where —
+        # no gathers.  Direction: ascending iff (lane & k) == 0, constant
+        # within each 2j block because 2j <= k.
+        k = 2
+        while k <= P:
+            j = k // 2
+            while j >= 1:
+                nblk = P // (2 * j)
+                halves = [c.reshape(nblk, 2, j) for c in cols]
+                a = [h[:, 0, :] for h in halves]
+                b = [h[:, 1, :] for h in halves]
+                keysel = list(range(num_keys)) + [len(cols) - 1]
+                gt = _lex_gt([a[i] for i in keysel],
+                             [b[i] for i in keysel])
+                blk = jax.lax.broadcasted_iota(jnp.int32, (nblk, j), 0)
+                asc = ((blk * (2 * j)) & k) == 0
+                swap = jnp.where(asc, gt, ~gt)
+                cols = [jnp.stack([jnp.where(swap, bi, ai),
+                                   jnp.where(swap, ai, bi)],
+                                  axis=1).reshape(P)
+                        for ai, bi in zip(a, b)]
+                j //= 2
+            k *= 2
+
+        # fused scan stage, still in VMEM: segment starts of the sorted
+        # primary key (ops/segment.py semantics) and the start-index
+        # combine — a plain cummax of start-masked positions, log-depth
+        # shift-max passes (the segmented-cummax trick: positions are
+        # monotone, so the global cummax IS the per-segment value)
+        k0 = cols[0]
+        pos = jax.lax.broadcasted_iota(jnp.int32, (P, 1), 0)[:, 0]
+        prev = jnp.concatenate([k0[:1], k0[:-1]])
+        starts = (pos == 0) | (k0 != prev)
+        sidx = jnp.where(starts, pos, 0)
+        d = 1
+        while d < P:
+            sidx = jnp.maximum(
+                sidx, jnp.concatenate([jnp.zeros(d, jnp.int32),
+                                       sidx[:-d]]))
+            d *= 2
+
+        for o, c in zip(outs[:n_in], cols[:n_in]):
+            o[:] = c
+        outs[n_in][:] = starts.astype(jnp.int32)
+        outs[n_in + 1][:] = sidx
+
+    out_shape = [jax.ShapeDtypeStruct((P,), jnp.int32)] * (n_in + 2)
+    kw = {}
+    if not interpret and pltpu is not None:
+        kw["in_specs"] = [pl.BlockSpec(memory_space=pltpu.VMEM)] * n_in
+        kw["out_specs"] = [pl.BlockSpec(memory_space=pltpu.VMEM)] * (
+            n_in + 2)
+    return pl.pallas_call(fused_sort_scan_kernel, out_shape=out_shape,
+                          interpret=interpret, **kw)(*padded)
+
+
+def fused_sort_scan(operands, num_keys: int, interpret: bool | None = None):
+    """Sort 1-D ``operands`` lexicographically by the first ``num_keys``
+    of them (stable: lane index is the implicit final key) and return
+    ``(sorted_operands, segment_starts, start_index)`` — the two scan
+    outputs computed in-kernel on the sorted primary key, at the
+    original width.  Booleans ride as int32 and convert back."""
+    ops = tuple(operands)
+    n = ops[0].shape[0]
+    P = 1 << max(1, (n - 1).bit_length())
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    conv = [o.astype(jnp.int32) if o.dtype == jnp.bool_ else o
+            for o in ops]
+    pad = P - n
+    if pad:
+        conv = [jnp.concatenate(
+            [c, jnp.full((pad,), _INT32_MAX if i < num_keys else 0,
+                         jnp.int32)])
+            for i, c in enumerate(conv)]
+    outs = _pallas_sort_scan(conv, num_keys, P, interpret)
+    sorted_ops = tuple(
+        (o[:n] == 1) if orig.dtype == jnp.bool_ else o[:n]
+        for o, orig in zip(outs[:len(ops)], ops))
+    return sorted_ops, outs[len(ops)][:n] == 1, outs[len(ops) + 1][:n]
+
+
+def maybe_fused_sort(cfg, operands, num_keys: int):
+    """Eligibility gate for one dispatch (ops/segment.py sort_pack):
+    returns ``(sorted_operands, starts, start_idx)`` when the pack fits
+    the fused kernel, else None after recording the loud fallback."""
+    ops = tuple(operands)
+    if any(o.ndim != 1 for o in ops):
+        return None                  # not an entry-lane sort; stay quiet
+    n = ops[0].shape[0]
+    P = 1 << max(1, (n - 1).bit_length())
+    if any(o.dtype not in (jnp.int32, jnp.bool_) for o in ops):
+        record_fallback(n, len(ops), "dtype")
+        return None
+    if len(ops) > MAX_OPERANDS:
+        record_fallback(n, len(ops), "operands")
+        return None
+    if P > cfg.fused_max_lanes:
+        record_fallback(n, len(ops), "width")
+        return None
+    if (2 * len(ops) + 3) * P * 4 > VMEM_BUDGET_BYTES:
+        record_fallback(n, len(ops), "vmem")
+        return None
+    return fused_sort_scan(ops, num_keys)

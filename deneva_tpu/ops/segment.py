@@ -16,10 +16,80 @@ required, so cost scales with B*R (live access entries), not table size.
 
 from __future__ import annotations
 
+import contextlib
 from typing import NamedTuple, Optional
 
 import jax.numpy as jnp
 from jax import lax
+
+# ---------------------------------------------------------------------------
+# fused-arbitration dispatch (Config.fused_arbitrate, ops/fused.py)
+# ---------------------------------------------------------------------------
+#
+# The engine wraps each tick body in ``fused_scope(cfg)`` at TRACE time
+# (engine/scheduler.py, parallel/sharded.py): a Python-level static, so
+# the dispatch below never becomes a traced branch and two engines with
+# different flags tracing in one process never leak into each other.
+# Inside an active scope every ``sort_pack`` call routes through the
+# fused Pallas bitonic-sort+segmented-scan kernel when the operand pack
+# is VMEM-eligible (ops/fused.py's loud static fallback otherwise).
+#
+# The kernel also computes the segment-start mask and start-index cummax
+# of the sorted primary key IN VMEM; ``_SCOPE_CACHE`` hands them to the
+# ``segment_starts`` / ``start_index`` calls that immediately follow a
+# fused ``sort_by`` (identity-keyed on the very tracer the kernel
+# returned, with a strong ref held until scope exit so ids can't be
+# reused mid-trace).
+
+_FUSED_CFG = None
+_SCOPE_CACHE: dict = {}
+
+
+@contextlib.contextmanager
+def fused_scope(cfg):
+    """Trace-time static dispatch scope; nested scopes restore the outer
+    config on exit (multi-engine test processes)."""
+    global _FUSED_CFG
+    prev = _FUSED_CFG
+    prev_cache = dict(_SCOPE_CACHE)
+    _FUSED_CFG = cfg if getattr(cfg, "fused_arbitrate", False) else None
+    _SCOPE_CACHE.clear()
+    try:
+        yield
+    finally:
+        _FUSED_CFG = prev
+        _SCOPE_CACHE.clear()
+        _SCOPE_CACHE.update(prev_cache)
+
+
+def _cache_scan(key_arr, starts, sidx):
+    _SCOPE_CACHE[id(key_arr)] = (key_arr, starts)
+    _SCOPE_CACHE[id(starts)] = (starts, sidx)
+
+
+def _cached(arr):
+    hit = _SCOPE_CACHE.get(id(arr))
+    if hit is not None and hit[0] is arr:
+        return hit[1]
+    return None
+
+
+def sort_pack(operands, num_keys: int, is_stable: bool = False):
+    """Drop-in for ``lax.sort(operands, num_keys, is_stable)``: inside an
+    active ``fused_scope`` an eligible pack runs the fused VMEM kernel
+    (whose lane-index tiebreak realizes exactly the stable order, a
+    valid result for both stability modes); otherwise — and always when
+    the flag is off — the identical ``lax.sort`` op is emitted."""
+    ops = tuple(operands)
+    if _FUSED_CFG is not None:
+        from deneva_tpu.ops import fused
+        hit = fused.maybe_fused_sort(_FUSED_CFG, ops, num_keys)
+        if hit is not None:
+            sorted_ops, starts, sidx = hit
+            if num_keys >= 1:
+                _cache_scan(sorted_ops[0], starts, sidx)
+            return sorted_ops
+    return lax.sort(ops, num_keys=num_keys, is_stable=is_stable)
 
 
 def sort_by(keys: tuple[jnp.ndarray, ...], payload: tuple[jnp.ndarray, ...]):
@@ -28,12 +98,16 @@ def sort_by(keys: tuple[jnp.ndarray, ...], payload: tuple[jnp.ndarray, ...]):
     Returns (sorted_keys, sorted_payload) tuples.
     """
     nk = len(keys)
-    out = lax.sort(tuple(keys) + tuple(payload), num_keys=nk, is_stable=True)
+    out = sort_pack(tuple(keys) + tuple(payload), num_keys=nk,
+                    is_stable=True)
     return out[:nk], out[nk:]
 
 
 def segment_starts(sorted_ids: jnp.ndarray) -> jnp.ndarray:
     """Boolean mask marking the first element of each equal-id run."""
+    hit = _cached(sorted_ids)          # fused kernel computed it in VMEM
+    if hit is not None:
+        return hit
     n = sorted_ids.shape[0]
     idx = jnp.arange(n, dtype=jnp.int32)
     return jnp.where(idx == 0, True, sorted_ids != jnp.roll(sorted_ids, 1))
@@ -41,6 +115,9 @@ def segment_starts(sorted_ids: jnp.ndarray) -> jnp.ndarray:
 
 def start_index(starts: jnp.ndarray) -> jnp.ndarray:
     """For each position, the index where its segment starts (via cummax)."""
+    hit = _cached(starts)              # fused kernel computed it in VMEM
+    if hit is not None:
+        return hit
     n = starts.shape[0]
     idx = jnp.arange(n, dtype=jnp.int32)
     return lax.cummax(jnp.where(starts, idx, 0), axis=0)
@@ -156,7 +233,7 @@ def unpermute_many(perm: jnp.ndarray, *vals: jnp.ndarray):
     in a lax.sort is far cheaper than a second full sort (PROFILE.md)."""
     conv = tuple(v.astype(jnp.int32) if v.dtype == jnp.bool_ else v
                  for v in vals)
-    out = lax.sort((perm,) + conv, num_keys=1, is_stable=False)[1:]
+    out = sort_pack((perm,) + conv, num_keys=1, is_stable=False)[1:]
     return tuple(o == 1 if v.dtype == jnp.bool_ else o
                  for o, v in zip(out, vals))
 
@@ -170,7 +247,7 @@ def unpermute(perm: jnp.ndarray, vals: jnp.ndarray) -> jnp.ndarray:
     Booleans are carried as int32 and converted back.
     """
     v = vals.astype(jnp.int32) if vals.dtype == jnp.bool_ else vals
-    _, out = lax.sort((perm, v), num_keys=1, is_stable=False)
+    _, out = sort_pack((perm, v), num_keys=1, is_stable=False)
     return out == 1 if vals.dtype == jnp.bool_ else out
 
 
@@ -299,7 +376,7 @@ def compact_entries(live: jnp.ndarray, K: int, *payloads: jnp.ndarray):
     keyrank = jnp.where(live, idx, n + idx)
     conv = tuple(p.astype(jnp.int32) if p.dtype == jnp.bool_ else p
                  for p in payloads)
-    srt = lax.sort((keyrank,) + conv, num_keys=1, is_stable=False)
+    srt = sort_pack((keyrank,) + conv, num_keys=1, is_stable=False)
     outs = tuple(o[:K] == 1 if p.dtype == jnp.bool_ else o[:K]
                  for o, p in zip(srt[1:], payloads))
     view = CompactView(

@@ -69,6 +69,7 @@ from deneva_tpu.obs.xmeter import XMeter, ledger_totals, state_ledger
 from deneva_tpu.engine.state import (BIG_TS, NULL_KEY, STATUS_BACKOFF,
                                      STATUS_FREE, STATUS_RUNNING,
                                      STATUS_WAITING, TxnState)
+from deneva_tpu.ops import segment as seg
 from deneva_tpu.parallel import routing
 from deneva_tpu.workloads.base import QueryPool
 
@@ -1068,7 +1069,17 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
                           pool_cursor=(state.pool_cursor + n_free) % Q,
                           ts_counter=ts_counter, net=net)
 
-    return tick_fn
+    if not cfg.fused_arbitrate:
+        return tick_fn
+
+    # fused-arbitration dispatch — same trace-time static switch as
+    # engine/scheduler.make_tick (ops/segment.fused_scope)
+    # lint: kernel
+    def tick_fused(state: ShardState, node_id) -> ShardState:
+        with seg.fused_scope(cfg):
+            return tick_fn(state, node_id)
+
+    return tick_fused
 
 
 class ShardedEngine:

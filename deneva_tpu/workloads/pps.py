@@ -274,8 +274,9 @@ class PPSWorkload(WorkloadPlugin):
                                             role_f, fields["earg"], cts,
                                             eff)
 
+        from deneva_tpu.ops import segment as seg
         idx = jnp.arange(n, dtype=jnp.int32)
-        out = jax.lax.sort(
+        out = seg.sort_pack(
             (jnp.where(eff, cts, OOB), idx, key_local, role_f,
              fields["earg"], cts, eff.astype(jnp.int32)),
             num_keys=2, is_stable=False)

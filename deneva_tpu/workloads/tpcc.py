@@ -493,8 +493,9 @@ class TPCCWorkload(WorkloadPlugin):
                                             role_f, fields["earg"],
                                             fields["earg2"], cts, eff)
 
+        from deneva_tpu.ops import segment as seg
         idx = jnp.arange(n, dtype=jnp.int32)
-        out = jax.lax.sort(
+        out = seg.sort_pack(
             (jnp.where(eff, cts, OOB), idx, key_local, role_f,
              fields["earg"], fields["earg2"], cts, eff.astype(jnp.int32)),
             num_keys=2, is_stable=False)

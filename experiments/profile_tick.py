@@ -14,6 +14,8 @@ Each measurement runs the target in a 200-iteration device-side
 (median of 3 windows after one discarded warmup dispatch).
 
 Usage: python experiments/profile_tick.py [B ...]
+       python experiments/profile_tick.py --compact [B]   (round-5 ablation)
+       python experiments/profile_tick.py --fused [B]     (round-7 ablation)
 """
 
 from __future__ import annotations
@@ -184,7 +186,42 @@ def compact_ablation(B):
               flush=True)
 
 
+def fused_ablation(B):
+    """Round-7 ablation: whole-tick ms with the fused VMEM
+    sort+scan kernel (Config.fused_arbitrate) on vs off, at compacted
+    width (compact_auto on BOTH sides, so the delta isolates the kernel
+    itself), plus the standalone lax.sort count left in each tick jaxpr
+    — the direct evidence of how many sort+scan chains the fused path
+    absorbed (MAAT, whose validate runs the longest chain, drops the
+    most)."""
+    ycsb = dict(batch_size=B, synth_table_size=1 << 24, req_per_query=10,
+                zipf_theta=0.6, tup_read_perc=0.5, query_pool_size=1 << 16,
+                warmup_ticks=0, backoff=True, acquire_window=1,
+                admit_cap=max(B // 8, 1), compact_auto=True)
+    tpcc = dict(workload="TPCC", cc_alg="MVCC", batch_size=B, num_wh=64,
+                cust_per_dist=2000, max_items=1024, query_pool_size=1 << 16,
+                warmup_ticks=0, admit_cap=max(B // 8, 1), compact_auto=True)
+    cells = [("MAAT/ycsb", dict(cc_alg="MAAT", **ycsb)),
+             ("MVCC/ycsb", dict(cc_alg="MVCC", **ycsb)),
+             ("NO_WAIT/ycsb", dict(cc_alg="NO_WAIT", **ycsb)),
+             ("TPCC/mvcc", tpcc)]
+    print(f"{'cell':>12} {'fused(ms)':>10} {'lax(ms)':>8} {'x':>5}  "
+          "standalone sorts (width histogram)")
+    for name, kw in cells:
+        on_ms, on_eng = time_engine_cfg(Config(fused_arbitrate=True, **kw))
+        off_ms, off_eng = time_engine_cfg(Config(**kw))
+        w_on, w_off = sort_widths(on_eng), sort_widths(off_eng)
+        n_on, n_off = sum(w_on.values()), sum(w_off.values())
+        print(f"{name:>12} {on_ms:>10.3f} {off_ms:>8.3f} "
+              f"{off_ms / on_ms:>5.2f}  {n_off}->{n_on} "
+              f"fused={w_on} lax={w_off}", flush=True)
+
+
 def main():
+    if "--fused" in sys.argv:
+        args = [a for a in sys.argv[1:] if a != "--fused"]
+        fused_ablation(int(args[0]) if args else 8192)
+        return
     if "--compact" in sys.argv:
         args = [a for a in sys.argv[1:] if a != "--compact"]
         compact_ablation(int(args[0]) if args else 8192)

@@ -113,6 +113,51 @@ if [ "$flt_rc" -ne 0 ]; then
     exit "$flt_rc"
 fi
 
+echo "== fused arbitration smoke (parity + sort-count) =="
+# the fused VMEM sort+scan kernel (Config.fused_arbitrate, ops/fused.py)
+# on one small contended MAAT cell, interpret mode on CPU: the [summary]
+# dict must be bit-identical to the lax path's, and the fused tick's
+# jaxpr must carry strictly fewer standalone lax.sort ops (the kernel
+# absorbed them); the full 7-plugin matrix lives in tests/test_fused.py
+env JAX_PLATFORMS=cpu python - <<'PYEOF'
+import jax
+from deneva_tpu.config import Config
+from deneva_tpu.engine.scheduler import Engine
+
+KW = dict(cc_alg="MAAT", batch_size=16, req_per_query=8,
+          synth_table_size=128, zipf_theta=0.8, query_pool_size=256,
+          admit_cap=4, max_ticks=10**6, warmup_ticks=0)
+
+
+def sorts(eng):
+    def walk(j):
+        n = 0
+        for eqn in j.eqns:
+            n += eqn.primitive.name == "sort"
+            for v in eqn.params.values():
+                for sub in (v if isinstance(v, (list, tuple)) else (v,)):
+                    if getattr(sub, "jaxpr", None) is not None:
+                        n += walk(sub.jaxpr)
+        return n
+    return walk(jax.make_jaxpr(eng._tick_fn)(eng.init_state()).jaxpr)
+
+
+out = {}
+for fused in (False, True):
+    eng = Engine(Config(fused_arbitrate=fused, **KW))
+    out[fused] = (eng.summary(eng.run(40)), sorts(eng))
+assert out[True][0] == out[False][0], "fused vs lax summary diverged"
+assert out[True][1] < out[False][1], \
+    f"fused tick kept {out[True][1]} sorts (lax {out[False][1]})"
+print(f"[fused] parity held; standalone sorts "
+      f"{out[False][1]} -> {out[True][1]}")
+PYEOF
+fused_rc=$?
+if [ "$fused_rc" -ne 0 ]; then
+    echo "fused smoke FAILED (parity/sort-count rc=$fused_rc)"
+    exit "$fused_rc"
+fi
+
 echo "== bench regression gate =="
 # gate the latest trajectory point (committed BENCH_r*.json snapshots +
 # any results/bench_history.jsonl) against the median of its priors;

@@ -148,7 +148,13 @@ def _assert_bit_identical(sc, sp, alg):
     assert not diff, f"{alg}: compacted vs padded summary diverged: {diff}"
 
 
-@pytest.mark.parametrize("alg", list(YCSB_K))
+# the MAAT cell compiles the chain-validate twice (compact + padded)
+# and alone costs ~15 s — `-m slow` per the tier-1 870 s budget split
+# (MAAT compacted-width parity stays tier-1 via the fused chain-gate
+# cells in test_fused.py)
+@pytest.mark.parametrize("alg", [
+    pytest.param(a, marks=pytest.mark.slow) if a == "MAAT" else a
+    for a in YCSB_K])
 def test_ycsb_parity_compact_vs_padded(alg):
     k = YCSB_K[alg]
     lanes = {} if k is None else {"compact_lanes": k}
@@ -161,9 +167,14 @@ def test_ycsb_parity_compact_vs_padded(alg):
 
 
 # the MAAT cell compiles the chain-validate twice (compact + padded)
-# and alone costs ~27 s — `-m slow` per the tier-1 870 s budget split
-@pytest.mark.parametrize("alg", ["NO_WAIT", "WAIT_DIE", "TIMESTAMP",
-                                 "MVCC", "OCC",
+# and alone costs ~27 s; WAIT_DIE/OCC (~8 s each) are redundant with
+# the YCSB parity sweep — `-m slow` per the tier-1 870 s budget split
+@pytest.mark.parametrize("alg", ["NO_WAIT",
+                                 pytest.param("WAIT_DIE",
+                                              marks=pytest.mark.slow),
+                                 "TIMESTAMP", "MVCC",
+                                 pytest.param("OCC",
+                                              marks=pytest.mark.slow),
                                  pytest.param("MAAT",
                                               marks=pytest.mark.slow),
                                  "CALVIN"])

@@ -1,0 +1,315 @@
+"""Fused bitonic-sort+segmented-scan kernel tests (ops/fused.py +
+the ``Config.fused_arbitrate`` dispatch in ops/segment.py).
+
+Four layers:
+
+1. primitive units: ``fused_sort_scan`` vs the ``lax.sort`` stable
+   reference — multi-operand packs with bool payloads, tie-heavy keys,
+   non-pow2 widths (padding edge), sentinel-valued real keys, and the
+   in-kernel segment-starts / start-index outputs vs the ops/segment.py
+   scans; all under ``jax.jit`` too;
+2. the structural claim: inside a ``fused_scope`` an eligible sort_by
+   emits ZERO standalone ``sort`` primitives in the jaxpr (the kernel's
+   bitonic network is compare-exchange only), while the default path is
+   untouched — the same histogram ``experiments/profile_tick.py
+   --fused`` prints;
+3. the headline guarantee: fused-vs-lax BIT-IDENTICAL ``[summary]``
+   lines for all seven CC plugins at compacted width (YCSB + TPC-C),
+   plus the MAAT chain-window gate — uncontended cells skip the
+   pairwise chain (``maat_chain_*`` counters stay zero) with parity
+   preserved on contended cells;
+4. the capacity discipline: an over-budget width falls back to
+   ``lax.sort`` STATICALLY and LOUDLY (warning + run-record accounting,
+   identical summaries — never a silent wrong answer), and the fused
+   tick stays recompile-free after warmup under the xmeter sentinel.
+"""
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deneva_tpu.config import Config
+from deneva_tpu.engine.scheduler import Engine
+from deneva_tpu.obs import profiler as obs_profiler
+from deneva_tpu.ops import fused
+from deneva_tpu.ops import segment as seg
+
+# ---------------------------------------------------------------------------
+# 1. primitive units vs the lax.sort stable reference
+
+
+def _rand_pack(n, num_keys, n_pay, seed, hi=6):
+    """Tie-heavy int32 keys (small value range) + mixed payloads, the
+    last payload a bool — exercises the dtype round trip."""
+    rng = np.random.default_rng(seed)
+    keys = tuple(jnp.asarray(rng.integers(0, hi, n).astype(np.int32))
+                 for _ in range(num_keys))
+    pays = tuple(jnp.asarray(rng.integers(0, 1 << 20, n).astype(np.int32))
+                 for _ in range(max(n_pay - 1, 0)))
+    if n_pay:
+        pays = pays + (jnp.asarray(rng.random(n) < 0.5),)
+    return keys + pays
+
+
+@pytest.mark.parametrize("n", [1, 2, 7, 64, 96, 128, 130])
+def test_fused_matches_stable_lax_sort(n):
+    ops = _rand_pack(n, num_keys=2, n_pay=3, seed=n)
+    got, _, _ = fused.fused_sort_scan(ops, num_keys=2)
+    want = jax.lax.sort(ops, num_keys=2, is_stable=True)
+    for g, w in zip(got, want):
+        assert g.dtype == w.dtype
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+
+
+def test_stable_tie_order_is_exact():
+    # all keys equal: a stable sort is the identity permutation
+    n = 37
+    keys = (jnp.zeros(n, jnp.int32),)
+    pay = (jnp.arange(n, dtype=jnp.int32) * 3,)
+    (sk, sp), _, _ = fused.fused_sort_scan(keys + pay, num_keys=1)
+    np.testing.assert_array_equal(np.asarray(sp), np.asarray(pay[0]))
+
+
+def test_sentinel_keys_survive_padding():
+    # real lanes carrying the INT32_MAX pad sentinel (NULL_KEY rows) must
+    # still sort into the real prefix: the lane-index tiebreak orders
+    # every real lane before every pad lane
+    k = jnp.asarray([2**31 - 1, 3, 2**31 - 1, 1, 2], jnp.int32)
+    p = jnp.arange(5, dtype=jnp.int32)
+    (sk, sp), starts, _ = fused.fused_sort_scan((k, p), num_keys=1)
+    wk, wp = jax.lax.sort((k, p), num_keys=1, is_stable=True)
+    np.testing.assert_array_equal(np.asarray(sk), np.asarray(wk))
+    np.testing.assert_array_equal(np.asarray(sp), np.asarray(wp))
+
+
+@pytest.mark.parametrize("n", [5, 64, 130])
+def test_in_kernel_scans_match_segment_reference(n):
+    ops = _rand_pack(n, num_keys=1, n_pay=1, seed=100 + n)
+    (sk, _), starts, sidx = fused.fused_sort_scan(ops, num_keys=1)
+    ref_starts = seg.segment_starts(sk)
+    ref_sidx = seg.start_index(ref_starts)
+    assert starts.dtype == ref_starts.dtype
+    np.testing.assert_array_equal(np.asarray(starts),
+                                  np.asarray(ref_starts))
+    np.testing.assert_array_equal(np.asarray(sidx), np.asarray(ref_sidx))
+
+
+def test_fused_sort_scan_under_jit():
+    ops = _rand_pack(96, num_keys=2, n_pay=2, seed=7)
+
+    @jax.jit
+    def f(*ops):
+        return fused.fused_sort_scan(ops, num_keys=2)
+
+    got, starts, _ = f(*ops)
+    want = jax.lax.sort(ops, num_keys=2, is_stable=True)
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+    np.testing.assert_array_equal(np.asarray(starts),
+                                  np.asarray(seg.segment_starts(want[0])))
+
+
+# ---------------------------------------------------------------------------
+# 2. dispatch structure: sort primitives leave the jaxpr inside the scope
+
+
+def _sort_eqn_count(jx):
+    n = 0
+    for eqn in jx.eqns:
+        if eqn.primitive.name == "sort":
+            n += 1
+        for v in eqn.params.values():
+            for sub in (v if isinstance(v, (list, tuple)) else (v,)):
+                inner = getattr(sub, "jaxpr", None)
+                if inner is not None:
+                    n += _sort_eqn_count(inner)
+    return n
+
+
+def test_scope_replaces_sort_primitive():
+    k = jnp.asarray([3, 1, 2, 1, 3, 1], jnp.int32)
+
+    # a fresh closure per trace: jax caches traces by function identity,
+    # and the scope is a trace-TIME static — exactly why the engine
+    # builds one tick closure per Engine (scheduler.make_tick)
+    def mk():
+        def f(k):
+            (sk,), (p,) = seg.sort_by(
+                (k,), (jnp.arange(6, dtype=jnp.int32),))
+            return sk, p, seg.segment_starts(sk)
+        return f
+
+    assert _sort_eqn_count(jax.make_jaxpr(mk())(k).jaxpr) == 1
+    cfg = Config(cc_alg="NO_WAIT", fused_arbitrate=True)
+    with seg.fused_scope(cfg):
+        fused_jx = jax.make_jaxpr(mk())(k)
+    assert _sort_eqn_count(fused_jx.jaxpr) == 0
+    # and the scope is not sticky
+    assert _sort_eqn_count(jax.make_jaxpr(mk())(k).jaxpr) == 1
+
+
+def test_scope_results_match_lax_path():
+    k = jnp.asarray([5, 1, 5, 2, 1, 1, 5, 9], jnp.int32)
+    v = jnp.arange(8, dtype=jnp.int32)
+
+    def f(k, v):
+        (sk,), (sv,) = seg.sort_by((k,), (v,))
+        st = seg.segment_starts(sk)
+        return sk, sv, st, seg.start_index(st)
+
+    base = f(k, v)
+    with seg.fused_scope(Config(cc_alg="NO_WAIT", fused_arbitrate=True)):
+        got = f(k, v)
+    for b, g in zip(base, got):
+        np.testing.assert_array_equal(np.asarray(b), np.asarray(g))
+
+
+# ---------------------------------------------------------------------------
+# 3. engine parity: fused vs lax bit-identical [summary]
+
+YCSB_KW = dict(batch_size=16, req_per_query=8, synth_table_size=128,
+               zipf_theta=0.8, query_pool_size=256, admit_cap=4,
+               max_ticks=10**6, warmup_ticks=0)
+
+TPCC_KW = dict(workload="TPCC", batch_size=64, num_wh=4, part_cnt=1,
+               node_cnt=1, query_pool_size=1024, cust_per_dist=1000,
+               max_items=128, perc_payment=0.5, admit_cap=16,
+               warmup_ticks=0)
+
+ALL_ALGS = ("NO_WAIT", "WAIT_DIE", "TIMESTAMP", "MVCC", "OCC", "MAAT",
+            "CALVIN")
+
+
+def _summary(cfg, n_ticks):
+    eng = Engine(cfg)
+    with warnings.catch_warnings():
+        # a loud capacity fallback is legal here; tested separately
+        warnings.simplefilter("ignore")
+        return eng.summary(eng.run(n_ticks))
+
+
+def _assert_fused_parity(alg, base_kw, n_ticks):
+    sl = _summary(Config(cc_alg=alg, **base_kw), n_ticks)
+    sf = _summary(Config(cc_alg=alg, fused_arbitrate=True, **base_kw),
+                  n_ticks)
+    diff = {k: (sl[k], sf.get(k)) for k in sl if sl[k] != sf.get(k)}
+    assert not diff, f"{alg}: fused vs lax summary diverged: {diff}"
+    assert set(sf) == set(sl)
+    assert sl["txn_cnt"] > 0
+
+
+# tier-1 870s budget split (precedent: PR 2/PR 6 re-splits): each pair
+# compiles two engines, so only the cheapest cell stays tier-1; MAAT's
+# fused-vs-lax bit-identity is ALSO tier-1 via the contended chain-gate
+# cell below, which doubles as its parity pair
+@pytest.mark.parametrize("alg", [
+    "NO_WAIT",
+    pytest.param("WAIT_DIE", marks=pytest.mark.slow),
+    pytest.param("TIMESTAMP", marks=pytest.mark.slow),
+    pytest.param("MVCC", marks=pytest.mark.slow),
+    pytest.param("OCC", marks=pytest.mark.slow),
+    pytest.param("MAAT", marks=pytest.mark.slow),
+    pytest.param("CALVIN", marks=pytest.mark.slow),
+])
+def test_ycsb_fused_parity(alg):
+    _assert_fused_parity(alg, YCSB_KW, n_ticks=120)
+
+
+# the TPC-C cells trace the fused bitonic network at the compact_auto
+# width (P=2048, ~120 inlined merge stages in interpret mode): each
+# pair compiles two full engines at 80-150s on CPU, so the whole matrix
+# rides the slow lane (precedent: test_compaction's TPC-C MAAT cell);
+# the YCSB matrix above keeps all seven plugins tier-1
+@pytest.mark.slow
+@pytest.mark.parametrize("alg", ALL_ALGS)
+def test_tpcc_fused_parity(alg):
+    _assert_fused_parity(alg, dict(compact_auto=True, **TPCC_KW),
+                         n_ticks=40)
+
+
+# ---- MAAT chain-window gate ----
+
+UNCONTENDED_KW = dict(batch_size=16, req_per_query=4,
+                      synth_table_size=65536, zipf_theta=0.0,
+                      query_pool_size=256, admit_cap=4,
+                      max_ticks=10**6, warmup_ticks=0)
+
+CONTENDED_KW = dict(batch_size=16, req_per_query=8, synth_table_size=64,
+                    zipf_theta=0.9, query_pool_size=256, admit_cap=8,
+                    max_ticks=10**6, warmup_ticks=0)
+
+
+def test_maat_chain_gate_skips_uncontended():
+    # no same-row same-tick validators -> the lax.cond takes the skip
+    # branch every tick: the chain never caps, never pushes, never
+    # overflows, and commits still flow
+    s = _summary(Config(cc_alg="MAAT", **UNCONTENDED_KW), 150)
+    assert s["txn_cnt"] > 0
+    assert s["maat_chain_cap_cnt"] == 0
+    assert s["maat_chain_push_cnt"] == 0
+    assert s["maat_chain_overflow_cnt"] == 0
+
+
+def test_maat_chain_gate_contended_parity():
+    # contended cell: the chain genuinely engages (counters move) and
+    # the fused path reproduces it bit-for-bit
+    sl = _summary(Config(cc_alg="MAAT", **CONTENDED_KW), 150)
+    sf = _summary(Config(cc_alg="MAAT", fused_arbitrate=True,
+                         **CONTENDED_KW), 150)
+    assert sl["maat_chain_cap_cnt"] > 0
+    assert sl["maat_chain_push_cnt"] > 0
+    assert sl == sf
+
+
+# ---------------------------------------------------------------------------
+# 4. capacity discipline + recompile sentinel
+
+
+def test_capacity_fallback_is_loud_and_counted():
+    fused.reset_fallbacks()
+    cfg = Config(cc_alg="NO_WAIT", fused_arbitrate=True,
+                 fused_max_lanes=32, **YCSB_KW)
+    eng = Engine(cfg)
+    with pytest.warns(UserWarning, match="fallback to lax.sort"):
+        st = eng.run(40)
+    s = eng.summary(st)
+    snap = fused.fallback_snapshot()
+    assert snap["count"] > 0
+    assert any(e["reason"] == "width" for e in snap["events"])
+    # counted in the run record (obs/profiler.py), NOT in [summary] —
+    # summary lines must stay bit-identical to the lax path's
+    rec = obs_profiler.run_record(cfg, s)
+    assert rec["fused_fallbacks"]["count"] == snap["count"]
+    assert "fused_fallbacks" not in s
+    # the fallback IS the lax path: never a silent wrong answer
+    sl = _summary(Config(cc_alg="NO_WAIT", **YCSB_KW), 40)
+    assert s == sl
+
+
+def test_ineligible_dtype_falls_back():
+    fused.reset_fallbacks()
+    cfg = Config(cc_alg="NO_WAIT", fused_arbitrate=True)
+    k64 = jnp.arange(8, dtype=jnp.float32)
+    with seg.fused_scope(cfg), warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        out = seg.sort_pack((k64,), num_keys=1)
+    np.testing.assert_array_equal(np.asarray(out[0]),
+                                  np.asarray(jnp.sort(k64)))
+    assert any(e["reason"] == "dtype"
+               for e in fused.fallback_snapshot()["events"])
+
+
+@pytest.mark.parametrize("alg", ["NO_WAIT", "MAAT"])
+def test_fused_zero_post_warm_recompiles(alg):
+    eng = Engine(Config(cc_alg=alg, fused_arbitrate=True, xmeter=True,
+                        **YCSB_KW))
+    st = eng.run(12)
+    xm = eng.xmeter
+    assert xm.entries["tick"].compile_cnt == 1
+    xm.mark_warm()
+    eng.run(12, st)
+    assert xm.steady_violations() == []
+    assert xm.entries["tick"].compile_cnt == 1

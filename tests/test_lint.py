@@ -196,6 +196,48 @@ GOOD_PAD_SORT_NO_VIEW = """
         return jax.lax.sort((key, ts), num_keys=1, is_stable=False)
 """
 
+GOOD_IS_NONE_DEFAULT = """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def step(x, scale=None):
+        if scale is None:
+            scale = jnp.sum(x)
+        return x * scale
+"""
+
+BAD_PALLAS_KERNEL = """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    def fused(x):
+        def kernel(x_ref, o_ref):
+            v = x_ref[:]
+            if jnp.sum(v) > 0:
+                o_ref[:] = v + 1
+            else:
+                o_ref[:] = v
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype))(x)
+"""
+
+GOOD_PALLAS_KERNEL = """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    def fused(x):
+        def kernel(x_ref, o_ref):
+            v = x_ref[:]
+            o_ref[:] = jnp.where(jnp.sum(v) > 0, v + 1, v)
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype))(x)
+"""
+
 BAD_JIT_IN_LOOP = """
     import jax
 
@@ -249,12 +291,14 @@ GOOD_JIT_HOISTED = """
     (BAD_HOST, "HOST-CALL"),
     (BAD_SCATTER, "SCATTER-RACE"),
     (BAD_PAD_SORT, "PAD-WIDTH-SORT"),
+    (BAD_PALLAS_KERNEL, "TRACED-BRANCH"),
     (BAD_JIT_IN_LOOP, "COMPILE-IN-LOOP"),
     (BAD_PARTIAL_JIT_IN_LOOP, "COMPILE-IN-LOOP"),
     (BAD_STATIC_ARGNUMS_IN_LOOP, "COMPILE-IN-LOOP"),
 ], ids=["traced-branch", "concretize-int", "concretize-item", "data-dep",
         "implicit-dtype", "host-call", "scatter-race", "pad-width-sort",
-        "jit-in-loop", "partial-jit-in-loop", "static-argnums-in-loop"])
+        "pallas-kernel-seeded", "jit-in-loop", "partial-jit-in-loop",
+        "static-argnums-in-loop"])
 def test_bad_fixture_is_flagged(tmp_path, code, rule):
     assert rule in active_rules(lint_src(tmp_path, code))
 
@@ -262,10 +306,12 @@ def test_bad_fixture_is_flagged(tmp_path, code, rule):
 @pytest.mark.parametrize("code", [
     GOOD_TRACED_BRANCH, GOOD_DATA_DEP, GOOD_DTYPE, GOOD_HOST,
     GOOD_SCATTER_ADD, GOOD_SCATTER_UNIQUE, GOOD_SCATTER_ARANGE,
-    GOOD_PAD_SORT_COMPACTED, GOOD_PAD_SORT_NO_VIEW, GOOD_JIT_HOISTED,
+    GOOD_PAD_SORT_COMPACTED, GOOD_PAD_SORT_NO_VIEW, GOOD_PALLAS_KERNEL,
+    GOOD_IS_NONE_DEFAULT, GOOD_JIT_HOISTED,
 ], ids=["where", "sized-nonzero", "explicit-dtype", "host-outside-kernel",
         "commutative-add", "declared-unique", "arange-index",
-        "sort-on-compacted", "sort-without-view", "jit-hoisted"])
+        "sort-on-compacted", "sort-without-view", "pallas-kernel-clean",
+        "is-none-default", "jit-hoisted"])
 def test_good_fixture_is_clean(tmp_path, code):
     assert active_rules(lint_src(tmp_path, code)) == []
 

@@ -198,7 +198,11 @@ def _reason_sum(s):
     return sum(s[f"abort_{n}_cnt"] for n in ABORT_REASONS)
 
 
-@pytest.mark.parametrize("alg", ALGS)
+# the MAAT cell compiles the chain-validate and alone costs ~14 s —
+# `-m slow` per the tier-1 870 s budget split
+@pytest.mark.parametrize("alg", [
+    pytest.param(a, marks=pytest.mark.slow) if a == "MAAT" else a
+    for a in ALGS])
 def test_taxonomy_exact_and_exhaustive(alg):
     # per-reason counters must sum EXACTLY to the aggregate abort counters
     # (vaborts count at both their own site and the total site — the

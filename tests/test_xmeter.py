@@ -39,7 +39,12 @@ def run_metered(n_ticks=12, **kw):
 
 # ---- recompile sentinel ---------------------------------------------------
 
-@pytest.mark.parametrize("alg", ALL_ALGS)
+# the MAAT cell compiles the chain-validate and alone costs ~13 s —
+# `-m slow` per the tier-1 870 s budget split (MAAT recompile freedom
+# stays tier-1 via test_fused.py's zero-post-warm-recompile cell set)
+@pytest.mark.parametrize("alg", [
+    pytest.param(a, marks=pytest.mark.slow) if a == "MAAT" else a
+    for a in ALL_ALGS])
 def test_exact_compile_counts_per_alg(alg):
     # ONE compile per entry point across warmup + steady state: the tick
     # jit and the final flush.  A second run after mark_warm must hit the
@@ -247,6 +252,32 @@ def test_regress_skips_failed_snapshots_and_arms_gates(tmp_path, capsys):
     # gates with no prior data self-arm (skip, not fail)
     res = obs_regress.gate([entries[0]])
     assert res["failures"] == [] and res["skipped"]
+
+
+def test_regress_required_cells_cannot_vanish(tmp_path, capsys):
+    # a headline point that DROPS a sort-bound cell the trajectory has
+    # carried (here MAAT) fails even though every present cell is
+    # healthy; a cell that never appeared only arms the requirement
+    def snap(n, algs):
+        doc = {"n": n, "rc": 0,
+               "parsed": {"metric": obs_regress.HEADLINE_METRIC,
+                          "value": 100.0,
+                          "algs": {a: {"commits_per_tick": 10.0}
+                                   for a in algs}}}
+        p = tmp_path / f"BENCH_r{n:02d}.json"
+        p.write_text(json.dumps(doc))
+        return str(p)
+
+    full = ("NO_WAIT",) + obs_regress.REQUIRED_CELLS
+    paths = [snap(1, full), snap(2, full),
+             snap(3, ("NO_WAIT", "MVCC", "OCC", "TPCC_MVCC_64wh"))]
+    rc = obs_regress.main(paths)
+    assert rc == 1
+    assert "required cell commits_per_tick[MAAT]" in capsys.readouterr().out
+    # never-seen cells skip (the synthetic NO_WAIT-only trajectories of
+    # the tests above must keep passing)
+    res = obs_regress.gate(obs_regress.load_trajectory(paths[:1]))
+    assert res["failures"] == []
 
 
 def test_regress_reads_bench_history_jsonl(tmp_path):
