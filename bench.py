@@ -39,6 +39,15 @@ KNEE (the highest rate still served with served/offered >= 0.95) and
 recording p50/p99 latency, queue depth and the OVERLOAD watchdog bit per
 point into ``offered_load_sweep.json``.  EXPERIMENTS.md has the recipe.
 
+With ``--scaling-grid`` the script runs the cluster scaling surface: a
+virtual-node grid (1/2/4/8, clamped to the device count) x two per-node
+batch shapes sized by the obs/xmeter.py ``fit_batch`` footprint model,
+every cell a ShardedEngine run with the mesh observatory
+(``Config.mesh``) on, so each scaling number carries the per-node-pair
+traffic matrix, Jain imbalance and remote-ratio that explain it.
+Writes ``scaling_grid.json``; EXPERIMENTS.md ("Diagnosing the flat MAAT
+scaling curve") reads it.
+
 Every headline run additionally APPENDS one JSON line to
 ``<out-dir>/bench_history.jsonl`` (unix time, git commit, config
 fingerprint, headline value, per-algorithm cells) — the trajectory that
@@ -50,7 +59,19 @@ import argparse
 import json
 import os
 import subprocess
+import sys
 import time
+
+# the --scaling-grid virtual-node grid needs >1 device on CPU hosts, and
+# --xla_force_host_platform_device_count only takes effect before the
+# jax backend initialises (imports below may touch it), so the flag is
+# set from argv BEFORE `import jax` — the same trick as tests/conftest.py
+if "--scaling-grid" in sys.argv and \
+        "xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8")
 
 import jax
 import numpy as np
@@ -303,6 +324,179 @@ _ALGS = ("NO_WAIT", "WAIT_DIE", "TIMESTAMP", "MVCC", "OCC", "MAAT",
          "CALVIN")
 
 
+# the small sharded cell every scaling-grid point runs (the OBS_KW analog
+# for the cluster engine): contended enough that cross-node waits shape
+# the curve, small enough that an 8-node CPU cell compiles in seconds
+GRID_KW = dict(
+    synth_table_size=1 << 12, req_per_query=4, zipf_theta=0.6,
+    tup_read_perc=0.5, query_pool_size=1 << 10, warmup_ticks=0, mpr=1.0,
+)
+
+
+def _state_nbytes(state) -> int:
+    return sum(getattr(leaf, "nbytes", 0)
+               for leaf in jax.tree_util.tree_leaves(state))
+
+
+def run_scaling_grid(args, out_dir: str = "results",
+                     history: bool = True) -> int:
+    """--scaling-grid: the nodes x batch cluster scaling surface.
+
+    Runs ShardedEngine cells over a virtual-node grid (default 1/2/4/8,
+    clamped to the device count) x TWO per-node batch shapes sized by the
+    obs/xmeter.py ``fit_batch`` footprint model (two probe states fit the
+    linear bytes(B) curve; the large shape is the biggest power of two
+    the ``--grid-budget-mb`` budget admits, capped for CPU smoke runs).
+    Every cell runs with ``Config.mesh`` on, so each point carries the
+    traffic-matrix diagnostics that EXPLAIN its scaling number:
+
+    - ``speedup``/``efficiency``  cluster commits/tick vs the same-shape
+      1-node cell (efficiency = speedup / nodes);
+    - ``imb_jain``                Jain fairness over per-node commits;
+    - ``remote_ratio``            remote entry attempts per requested
+      access (txn_cnt * req_per_query) — the mesh's share of the work;
+    - ``straggler_ticks``/``mesh_drops``/``watchdog``.
+
+    Each cell's mesh matrix is reconciled exactly (obs/mesh.py
+    reconcile); any mismatch — or a zero-commit cell — fails the run.
+    Writes ``<out-dir>/scaling_grid.json``, prints the headline JSON
+    line, and appends a ``scaling_grid`` record whose per-cell
+    ``efficiency`` values feed the obs/regress.py gate.  EXPERIMENTS.md
+    ("Diagnosing the flat MAAT scaling curve") reads the output.
+
+    Exit code 0 when every cell committed work and reconciled; 1
+    otherwise."""
+    from deneva_tpu.obs import mesh as obs_mesh
+    from deneva_tpu.obs import report as obs_report
+    from deneva_tpu.obs import xmeter as obs_xmeter
+    from deneva_tpu.parallel.sharded import ShardedEngine
+
+    # the grid defaults to MAAT — the flat-scaling curve under diagnosis
+    # (ROADMAP item 2) — but --algs sweeps any subset
+    alg_list = (["MAAT"] if args.algs == "all"
+                else [a.strip().upper() for a in args.algs.split(",") if a])
+    node_grid = [int(n) for n in args.grid_nodes.split(",") if n]
+    avail = jax.device_count()
+    usable = [n for n in node_grid if n <= avail]
+    if usable != node_grid:
+        print(f"[scaling-grid] {avail} devices: node grid clamped to "
+              f"{usable}")
+    if not usable:
+        print("[scaling-grid] no runnable node counts")
+        return 1
+
+    def grid_cfg(alg, n, b):
+        return Config(cc_alg=alg, node_cnt=n, part_cnt=n, batch_size=b,
+                      part_per_txn=min(2, n), mesh=True, **GRID_KW)
+
+    # two batch shapes from the footprint model: probe the sharded state
+    # at B=32 and B=64, fit bytes(B) = fixed + per_txn * B, take the
+    # largest power-of-two batch the budget admits (capped so the CPU
+    # smoke stays fast), with the 32/node shape as the small anchor
+    probe_n = min(2, avail)
+    probes = {b: _state_nbytes(
+        ShardedEngine(grid_cfg(alg_list[0], probe_n, b)).init_state())
+        for b in (32, 64)}
+    fit = obs_xmeter.fit_batch(args.grid_budget_mb, probes,
+                               node_cnt=max(usable))
+    large = 64
+    while large * 2 <= min(fit["max_batch_per_node"], args.grid_max_batch):
+        large *= 2
+    shapes = (32, large) if large > 32 else (16, 32)
+    print(f"[scaling-grid] fit_batch: per_txn={fit['per_txn_bytes']:.0f}B "
+          f"fixed={fit['fixed_bytes']}B -> max "
+          f"{fit['max_batch_per_node']}/node under "
+          f"{args.grid_budget_mb:.0f}MB; shapes {shapes}")
+
+    code = 0
+    grid = {alg: [] for alg in alg_list}
+    cells_hist = {}
+    for alg in alg_list:
+        for b in shapes:
+            base_cpt = None
+            for n in usable:
+                cfg = grid_cfg(alg, n, b)
+                eng = ShardedEngine(cfg)
+                state = eng.run_compiled(args.ticks)       # compile+warm
+                jax.block_until_ready(state.stats["txn_cnt"])
+                before = int(np.asarray(state.stats["txn_cnt"]).sum())
+                t0 = time.perf_counter()
+                state = eng.run_compiled(args.ticks, state)
+                jax.block_until_ready(state.stats["txn_cnt"])
+                dt = time.perf_counter() - t0
+                s = eng.summary(state)
+                snap = eng.mesh_snapshot(state)
+                bad = obs_mesh.reconcile(snap, s)
+                for what, got, want in bad:
+                    print(f"[scaling-grid] {alg} n={n} B={b} RECONCILE "
+                          f"MISMATCH {what}: got={got} want={want}")
+                    code = 1
+                ticks = max(s["measured_ticks"], 1)
+                cpt = s["txn_cnt"] / ticks
+                if n == usable[0]:
+                    base_cpt = cpt
+                if s["txn_cnt"] == 0:
+                    code = 1
+                # speedup vs the smallest grid point at this shape,
+                # normalised to its node count (speedup==nodes is ideal)
+                speedup = (cpt / base_cpt * usable[0]
+                           if base_cpt else 0.0)
+                accesses = max(s["txn_cnt"] * cfg.req_per_query, 1)
+                _, wd = obs_report.watchdog(s)
+                cell = {
+                    "nodes": n, "batch_per_node": b,
+                    "commits_per_tick": round(cpt, 2),
+                    "tput": round((int(np.asarray(
+                        state.stats["txn_cnt"]).sum()) - before) / dt, 1),
+                    "speedup": round(speedup, 3),
+                    "efficiency": round(speedup / n, 4),
+                    "imb_jain": round(float(s["imb_jain"]), 4),
+                    "remote_ratio": round(
+                        s["remote_entry_cnt"] / accesses, 4),
+                    "straggler_ticks": s["straggler_tick_cnt"],
+                    "mesh_drops": s["mesh_drop_cnt"],
+                    "watchdog": wd,
+                }
+                grid[alg].append(cell)
+                cells_hist[f"{alg}@{n}x{b}"] = {
+                    "commits_per_tick": cell["commits_per_tick"],
+                    "efficiency": cell["efficiency"]}
+                print(f"[scaling-grid] {alg} n={n} B={b}: "
+                      f"{cell['commits_per_tick']} commits/tick, "
+                      f"speedup {cell['speedup']} "
+                      f"(eff {cell['efficiency']}), "
+                      f"jain {cell['imb_jain']}, "
+                      f"remote {cell['remote_ratio']}")
+    head = grid[alg_list[0]][-1] if grid[alg_list[0]] else {}
+    doc = {
+        "metric": "scaling_grid",
+        "value": head.get("efficiency", 0.0),
+        "unit": "parallel_efficiency",
+        "ticks": args.ticks,
+        "nodes": usable,
+        "batch_shapes": list(shapes),
+        "fit_batch": fit,
+        "scaling_grid": cells_hist,
+        "grid": grid,
+        "note": "nodes x per-node-batch surface on the small sharded "
+                "cell (GRID_KW, Config.mesh on); speedup = cluster "
+                "commits/tick vs the smallest same-shape point scaled "
+                "to its node count, efficiency = speedup/nodes; "
+                "remote_ratio = remote entry attempts per requested "
+                "access; value = the last alg's largest cell efficiency",
+    }
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, "scaling_grid.json")
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(json.dumps({k: v for k, v in doc.items() if k != "grid"}))
+    print(f"[scaling-grid] grid written: {path}")
+    if history:
+        _append_history(doc, grid_cfg(alg_list[0], usable[-1], shapes[-1]),
+                        out_dir)
+    return code
+
+
 def run_flight(args, out_dir: str = "results", history: bool = True) -> int:
     """--flight: transaction flight recorder sweep (obs/flight.py).
 
@@ -422,7 +616,10 @@ def _append_history(doc: dict, cfg: Config, out_dir: str = "results") -> str:
     # per-algorithm knee ride along; regress keys the trajectory on the
     # distinct "offered_load_knee" metric + "<ALG>@knee" cells, so the
     # headline tput trajectories are untouched
-    for k in ("offered_load", "knee"):
+    # --scaling-grid cells ride the same way: the per-cell efficiency
+    # dict keys a distinct "scaling_grid" trajectory in obs/regress.py
+    for k in ("offered_load", "knee", "nodes", "batch_shapes",
+              "scaling_grid"):
         if k in doc:
             rec[k] = doc[k]
     os.makedirs(out_dir, exist_ok=True)
@@ -591,6 +788,24 @@ def _cli():
     p.add_argument("--algs", default="all",
                    help="comma-separated CC algorithms for "
                         "--offered-load (default: all seven)")
+    p.add_argument("--scaling-grid", action="store_true",
+                   help="cluster scaling surface: virtual-node grid x "
+                        "two fit_batch-sized per-node batch shapes on "
+                        "the sharded engine with Config.mesh on; writes "
+                        "scaling_grid.json with speedup/efficiency/"
+                        "imbalance/remote-ratio per cell (exit 1 on a "
+                        "mesh reconcile mismatch or zero-commit cell)")
+    p.add_argument("--grid-nodes", default="1,2,4,8",
+                   help="comma-separated node counts for --scaling-grid "
+                        "(clamped to the device count)")
+    p.add_argument("--grid-budget-mb", type=float, default=256.0,
+                   help="per-node HBM budget feeding the fit_batch "
+                        "model that sizes the large --scaling-grid "
+                        "batch shape")
+    p.add_argument("--grid-max-batch", type=int, default=256,
+                   help="cap on the fit_batch-derived per-node batch "
+                        "shape (keeps the CPU smoke fast; raise on "
+                        "real chips)")
     p.add_argument("--flight", action="store_true",
                    help="transaction flight recorder sweep: per-alg "
                         "full-sampling lifecycle spans, exact phase/"
@@ -618,6 +833,9 @@ def _cli():
 
 if __name__ == "__main__":
     _args = _cli()
+    if _args.scaling_grid:
+        raise SystemExit(run_scaling_grid(_args, out_dir=_args.out_dir,
+                                          history=not _args.no_history))
     if _args.offered_load:
         raise SystemExit(run_offered_load(_args, out_dir=_args.out_dir,
                                           history=not _args.no_history))

@@ -389,6 +389,23 @@ class Config:
     #: the result from ``engine.profiler.snapshot()``.
     profile: bool = False
 
+    #: cluster mesh observatory (deneva_tpu/obs/mesh.py): when True the
+    #: SHARDED engine carries per-node traffic planes — an (N, T) tx
+    #: matrix row (messages this node sent to each dest, tagged by
+    #: message type: request / response / prepare-vote / commit-effect /
+    #: replication / Calvin epoch exchange) and its (N, T) rx mirror —
+    #: accumulated at the existing dest-routing and exchange sites with
+    #: exact identities: delivered+dropped request rows reconcile against
+    #: ``remote_entry_cnt``, tx == rx-transposed bit-exact per type, and
+    #: (net_delay mode) the in-flight type decomposition sums to
+    #: ``lat_msg_queue_time``.  Plus per-node load planes (exchange-A
+    #: occupancy vs cap, its peak, a pmax straggler bit) feeding the
+    #: Jain's-fairness imbalance index and the [mesh] report section /
+    #: IMBALANCE watchdog bit (obs/report.py).  Single-shard engines
+    #: ignore the flag (no mesh to observe).  Off by default — zero
+    #: extra device arrays and a byte-identical [summary] line.
+    mesh: bool = False
+
     #: compile & memory observatory (deneva_tpu/obs/xmeter.py): per-entry
     #: recompile sentinel (compile counts + trigger signatures; a steady
     #: run must report ZERO post-warmup recompiles), HBM footprint ledger

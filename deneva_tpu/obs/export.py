@@ -5,9 +5,9 @@ every given run record (obs/profiler.py write_run_record documents) into
 ONE Chrome trace-event JSON loadable at ui.perfetto.dev:
 
 - the per-tick counter tracks rebuilt from each record's ``timeline``
-  series (the same six-track grouping as obs/trace.py to_chrome_trace:
+  series (the same track grouping as obs/trace.py to_chrome_trace:
   txn flow, slot occupancy, compaction, plus the conditional abort-
-  reasons and admission-queue tracks);
+  reasons, admission-queue, and per-node-pair mesh-traffic tracks);
 - the per-txn SPAN track from each record's ``flight`` snapshot
   (obs/flight.py span_events: nested lifecycle/attempt slices with
   abort-reason flow arrows) — counters above, the sampled lifecycles
@@ -62,6 +62,11 @@ def record_events(rec: dict, pid_base: int = 0, tick_us: float = 1.0,
     if flight:
         n_nodes = max(n_nodes, int(flight.get("nodes", 1)))
     reason_names = sorted(k for k in timeline if k.startswith("abort_"))
+    # per-node-pair outbound traffic of mesh-observatory runs; numeric
+    # sort so to10 doesn't land between to1 and to2
+    mesh_names = sorted((k for k in timeline
+                         if k.startswith("mesh_tx_to")),
+                        key=lambda k: int(k[len("mesh_tx_to"):]))
     for node in range(n_nodes):
         pid = pid_base + node
         pname = label or "engine"
@@ -82,7 +87,8 @@ def record_events(rec: dict, pid_base: int = 0, tick_us: float = 1.0,
                                "args": {c: int(series[c][t])
                                         for c in series}})
         for t_name, cols in (("abort reasons", reason_names),
-                             ("admission queue", ("queue_depth",))):
+                             ("admission queue", ("queue_depth",)),
+                             ("mesh traffic", mesh_names)):
             series = {c: _series(timeline, c, node, n_nodes)
                       for c in cols}
             series = {c: s for c, s in series.items() if s is not None}

@@ -1,0 +1,414 @@
+"""Cluster mesh observatory: the per-node-pair traffic matrix (obs
+pillar 8 — the last unobserved subsystem).
+
+The reference attributes distributed performance to MESSAGES — per-type
+counters and queue delays in statistics/stats.cpp (msg_queue_delay,
+msg_send/receive per RemReqType) behind the VLDB'17 finding that
+coordination cost dominates at scale — yet the sharded engine collapsed
+all cross-node behavior into one ``remote_entry_cnt`` scalar.  Opt-in
+through ``Config.mesh``, every node carries two ``(N, T)`` int32 planes
+inside its stats dict (node-stacked under shard_map, so the fetched
+cluster tensors are ``(N, N, T)``):
+
+- ``arr_mesh_tx``  row ``i`` of the cluster matrix: messages THIS node
+  delivered to dest ``j``, tagged by message type ``t``;
+- ``arr_mesh_rx``  the mirror: messages received FROM src ``i``.
+
+The type axis (:data:`MSG_TYPES`) rebuilds the reference's RemReqType
+taxonomy at the exchange sites of ``parallel/sharded.py``:
+
+====== =========== ====================================================
+ col    type        accumulation site
+====== =========== ====================================================
+ 0      request     exchange A (RQRY): delivered non-finishing entries
+ 1      response    exchange A' (RQRY_RSP/RACK): one decision word per
+                    delivered entry, counted at BOTH ends
+ 2      prepare     exchange A entries flagged for validation (the 2PC
+                    prepare/vote leg riding exchange A, flags bit 3)
+ 3      commit      exchange B (RFIN): delivered commit-effect entries
+ 4      repl        log-replication ppermute records (LOG_MSG)
+ 5      epoch       Calvin: ALL exchange-A traffic (the sequencer's
+                    epoch fan-out incl. recon-shadow reads) classifies
+                    here instead of request/prepare
+====== =========== ====================================================
+
+NOT counted (documented non-messages): the MaaT commit-forward-push
+third leg (dense lanes riding the A-pack permutation — bounds piggyback
+on the response, not a new message) and the replication-ack ppermutes
+(scalar high-water marks).  AP replica nodes therefore have all-zero tx
+rows except their (empty) repl lane.
+
+Exact identities (all warmup-gated with the same ``measuring`` mask as
+the counters they reconcile against; tests/test_mesh.py):
+
+- per node: ``tx`` row-sum over {request, prepare, epoch}
+  + ``mesh_drop_cnt`` (exchange-A overflow)  ==  ``remote_entry_cnt``
+  (attempted == delivered + dropped);
+- ``tx[i, j, t] == rx[j, i, t]`` bit-exact for every type (both ends of
+  the same all_to_all / ppermute count the same delivered lanes);
+- per pair: ``tx[j, i, response] == tx[i, j, request+prepare+epoch]``
+  (one decision word back per delivered entry);
+- net_delay runs: ``arr_mesh_inflight`` (the per-type in-transit
+  message population) sums to the ``lat_msg_queue_time`` integral;
+- the device-psum'd cluster matrix (:func:`cluster_matrix`) is
+  bit-exact equal to the host sum of per-node tx planes.
+
+Load planes ride along: per-tick exchange-A occupancy (delivered
+entries vs ``cap``) integrates into ``mesh_occ_sum`` / ``mesh_occ_peak``
+and a pmax straggler bit (``straggler_tick_cnt``: ticks this node's
+occupancy topped the cluster); host side, per-node commit loads fold
+into Jain's fairness index ``imb_jain`` (1.0 = perfectly balanced,
+1/N = one node doing everything), the ``[mesh]`` report section and the
+IMBALANCE watchdog bit (obs/report.py).  With ``Config.trace_ticks``
+a per-dest sent-count companion ring (``arr_mesh_trace``) feeds the
+per-node-pair Perfetto counter tracks (obs/trace.py / obs/export.py).
+
+When ``Config.mesh`` is False (default) no arrays are carried and the
+[summary] line is byte-identical to a build without this module.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from deneva_tpu.engine.state import NULL_KEY
+
+#: message-type axis of the traffic tensor (the RemReqType rebuild)
+MSG_TYPES = ("request", "response", "prepare", "commit", "repl", "epoch")
+REQ, RESP, PREP, COMMIT, REPL, EPOCH = range(len(MSG_TYPES))
+
+#: the exact [summary] surface the observatory adds (tests assert it):
+#: the four int counters ride the sharded psum; imb_jain / mesh_tx_total
+#: are host-computed in ShardedEngine.summary
+MESH_SUMMARY_KEYS = ("mesh_drop_cnt", "mesh_occ_sum", "mesh_occ_peak",
+                     "straggler_tick_cnt", "imb_jain", "mesh_tx_total")
+
+#: Jain's index below this (with commits flowing) fires the IMBALANCE
+#: watchdog bit.  J = k/n when k of n nodes carry all the load, so a
+#: balanced AP cluster (replicas commit nothing by design) sits at
+#: ~0.5 - epsilon; the threshold lives strictly below that by-design
+#: asymmetry so AP runs stay clean while genuine straggler collapse
+#: (well under half the cluster doing the work) fires.
+IMB_JAIN_MIN = 0.45
+
+
+# ---------------------------------------------------------------------------
+# device side (jit-safe; every helper no-ops when the plane is absent)
+# ---------------------------------------------------------------------------
+
+def init_mesh(cfg, n_nodes: int) -> dict:
+    """Stats-dict entries for the observatory; empty when off (the
+    disabled path carries nothing)."""
+    if not cfg.mesh:
+        return {}
+    T = len(MSG_TYPES)
+    out = {
+        "arr_mesh_tx": jnp.zeros((n_nodes, T), jnp.int32),
+        "arr_mesh_rx": jnp.zeros((n_nodes, T), jnp.int32),
+        # exchange-A overflow: attempted-but-dropped entries, so
+        # delivered + dropped reconciles against remote_entry_cnt
+        "mesh_drop_cnt": jnp.zeros((), jnp.int32),
+        # exchange-A occupancy integral / peak (delivered entries per
+        # tick; the psum'd cluster peak is the SUM of per-node peaks,
+        # a pressure bound like queue_peak, not a max)
+        "mesh_occ_sum": jnp.zeros((), jnp.int32),
+        "mesh_occ_peak": jnp.zeros((), jnp.int32),
+        # ticks this node's occupancy equalled the cluster pmax (> 0);
+        # ties count on every tied node
+        "straggler_tick_cnt": jnp.zeros((), jnp.int32),
+    }
+    if cfg.net_delay_ticks > 0:
+        # per-type in-transit message population; sums to the
+        # lat_msg_queue_time integral (only a delay model has transit)
+        out["arr_mesh_inflight"] = jnp.zeros(T, jnp.int32)
+    if cfg.trace_ticks > 0:
+        # per-dest sent-count companion ring for the per-node-pair
+        # Perfetto counter tracks — SEPARATE array so TRACE_COLUMNS and
+        # every consumer of it stay unchanged (obs/trace.py discipline)
+        out["arr_mesh_trace"] = jnp.zeros((cfg.trace_ticks, n_nodes),
+                                          jnp.int32)
+    return out
+
+
+def note_exchange_a(stats: dict, dest, shipped, dropped, fin_e, is_epoch,
+                    n_nodes: int, measuring):
+    """Home side of exchange A: type-tagged tx scatter of delivered
+    entries (+ the response-leg rx mirror — one decision word will come
+    back per delivered entry) and the drop counter.  Returns
+    ``(stats, per_dest)`` where ``per_dest`` is the UNGATED (N,)
+    delivered-count vector (occupancy + trace ring input; None off)."""
+    if "arr_mesh_tx" not in stats:
+        return stats, None
+    inc = jnp.where(measuring & shipped, 1, 0).astype(jnp.int32)
+    # classification is static per plugin (Calvin's A traffic IS the
+    # epoch fan-out); otherwise flags bit 3 splits prepare from request
+    col = (jnp.full_like(dest, EPOCH) if is_epoch
+           else jnp.where(fin_e, PREP, REQ).astype(jnp.int32))
+    # commutative scatter-add; dead/overflow lanes carry inc == 0 and
+    # dest == n_nodes drops (LINT.md race-free idiom)
+    tx = stats["arr_mesh_tx"].at[dest, col].add(inc, mode="drop")
+    rx = stats["arr_mesh_rx"].at[dest, RESP].add(inc, mode="drop")
+    drop = stats["mesh_drop_cnt"] + jnp.sum(
+        jnp.where(measuring & dropped, 1, 0).astype(jnp.int32))
+    per_dest = jnp.zeros(n_nodes, jnp.int32).at[dest].add(
+        shipped.astype(jnp.int32), mode="drop")
+    return {**stats, "arr_mesh_tx": tx, "arr_mesh_rx": rx,
+            "mesh_drop_cnt": drop}, per_dest
+
+
+def note_owner_rx(stats: dict, recv_key, recv_flags, is_epoch, measuring
+                  ) -> dict:
+    """Owner side of exchange A: received live lanes per src row (+ the
+    response-leg tx mirror — this node returns one decision word per
+    live lane it received)."""
+    if "arr_mesh_rx" not in stats:
+        return stats
+    g = jnp.where(measuring & (recv_key != NULL_KEY), 1, 0).astype(
+        jnp.int32)                                    # (N, cap)
+    n_live = jnp.sum(g, axis=1)                       # (N,) per src
+    rx = stats["arr_mesh_rx"]
+    if is_epoch:
+        rx = rx.at[:, EPOCH].add(n_live)
+    else:
+        fin = ((recv_flags >> 3) & 1) == 1
+        n_fin = jnp.sum(jnp.where(fin, g, 0), axis=1)
+        rx = rx.at[:, PREP].add(n_fin)
+        rx = rx.at[:, REQ].add(n_live - n_fin)
+    tx = stats["arr_mesh_tx"].at[:, RESP].add(n_live)
+    return {**stats, "arr_mesh_rx": rx, "arr_mesh_tx": tx}
+
+
+def note_commit_exchange(stats: dict, dest, shipped, recv_key, measuring
+                         ) -> dict:
+    """Exchange B (RFIN): delivered commit-effect entries, both ends.
+    ``shipped`` must already exclude local and overflowed lanes (a
+    deferred txn's successfully-packed entries DID travel — they count;
+    the owner ignores them via the commit flag, not the wire)."""
+    if "arr_mesh_tx" not in stats:
+        return stats
+    inc = jnp.where(measuring & shipped, 1, 0).astype(jnp.int32)
+    tx = stats["arr_mesh_tx"].at[dest, COMMIT].add(inc, mode="drop")
+    live = jnp.where(measuring & (recv_key != NULL_KEY), 1, 0).astype(
+        jnp.int32)
+    rx = stats["arr_mesh_rx"].at[:, COMMIT].add(jnp.sum(live, axis=1))
+    return {**stats, "arr_mesh_tx": tx, "arr_mesh_rx": rx}
+
+
+def note_repl(stats: dict, dest_idx, n_sent, src_idx, n_recv, measuring
+              ) -> dict:
+    """Log-replication ppermute (LOG_MSG records): per-record counts at
+    both ends.  Callers pass clamped indices (``n_nodes`` == no peer,
+    dropped); the scalar ack ppermutes are NOT messages (documented)."""
+    if "arr_mesh_tx" not in stats:
+        return stats
+    z = jnp.int32(0)
+    tx = stats["arr_mesh_tx"].at[dest_idx, REPL].add(
+        jnp.where(measuring, n_sent, z), mode="drop")
+    rx = stats["arr_mesh_rx"].at[src_idx, REPL].add(
+        jnp.where(measuring, n_recv, z), mode="drop")
+    return {**stats, "arr_mesh_tx": tx, "arr_mesh_rx": rx}
+
+
+def note_inflight(stats: dict, n_req, n_resp, n_prep, measuring) -> dict:
+    """net_delay mode: the tick's in-transit message population split by
+    type — requests still travelling to owners; responses = grant words
+    plus abort decisions in transit home; prepare = 2PC prepare requests
+    and vote words in flight.  The three sum to exactly the
+    ``lat_msg_queue_time`` bump of the same tick."""
+    if "arr_mesh_inflight" not in stats:
+        return stats
+    z = jnp.int32(0)
+    # lane order is the MSG_TYPES order: req, resp, prep, commit/repl/
+    # epoch never travel through the delay buffers
+    vec = jnp.stack([jnp.asarray(n_req, jnp.int32),
+                     jnp.asarray(n_resp, jnp.int32),
+                     jnp.asarray(n_prep, jnp.int32), z, z, z])
+    return {**stats, "arr_mesh_inflight":
+            stats["arr_mesh_inflight"] + jnp.where(measuring, vec, 0)}
+
+
+def note_occupancy(stats: dict, per_dest, axis_name: str, measuring
+                   ) -> dict:
+    """Exchange-A occupancy load plane + the pmax straggler bit (the
+    node whose delivered-entry count peaks this tick; ties all count)."""
+    if "mesh_occ_sum" not in stats or per_dest is None:
+        return stats
+    occ = jnp.sum(per_dest)
+    mx = jax.lax.pmax(occ, axis_name)
+    g = jnp.where(measuring, occ, 0)
+    strag = measuring & (occ == mx) & (mx > 0)
+    return {**stats,
+            "mesh_occ_sum": stats["mesh_occ_sum"] + g,
+            "mesh_occ_peak": jnp.maximum(stats["mesh_occ_peak"], g),
+            "straggler_tick_cnt": stats["straggler_tick_cnt"]
+            + strag.astype(jnp.int32)}
+
+
+def note_trace(stats: dict, t, per_dest) -> dict:
+    """Per-dest sent counts into the companion ring (wrap-and-accumulate,
+    NOT warmup-gated — the trace-ring discipline of obs/trace.py)."""
+    if "arr_mesh_trace" not in stats or per_dest is None:
+        return stats
+    buf = stats["arr_mesh_trace"]
+    return {**stats, "arr_mesh_trace":
+            buf.at[t % buf.shape[0]].add(per_dest, unique_indices=True)}
+
+
+# ---------------------------------------------------------------------------
+# host side
+# ---------------------------------------------------------------------------
+
+def jain(xs) -> float:
+    """Jain's fairness index (sum x)^2 / (n * sum x^2): 1.0 = perfectly
+    balanced, 1/n = one node doing everything; 1.0 for an all-zero
+    vector (nothing flowed, nothing is unfair)."""
+    xs = np.asarray(xs, dtype=np.float64).reshape(-1)
+    denom = xs.size * float((xs * xs).sum())
+    if denom == 0.0:
+        return 1.0
+    return float(xs.sum()) ** 2 / denom
+
+
+def snapshot(state_or_stats) -> dict:
+    """Fetch the node-stacked planes to numpy: the (N, N, T) cluster
+    tensors (axis 0 = sender for ``tx``, receiver for ``rx``), the
+    per-node load planes, and the per-type inflight populations."""
+    stats = getattr(state_or_stats, "stats", state_or_stats)
+    assert "arr_mesh_tx" in stats, "run with Config.mesh=True"
+    tx = np.asarray(stats["arr_mesh_tx"])
+    rx = np.asarray(stats["arr_mesh_rx"])
+    assert tx.ndim == 3, "mesh planes are node-stacked (sharded engine)"
+
+    def per(k):
+        return (np.asarray(stats[k]).reshape(-1).copy()
+                if k in stats else None)
+
+    snap = {
+        "nodes": tx.shape[0],
+        "types": list(MSG_TYPES),
+        "tx": tx, "rx": rx,
+        "drops": per("mesh_drop_cnt"),
+        "occ_sum": per("mesh_occ_sum"),
+        "occ_peak": per("mesh_occ_peak"),
+        "straggler": per("straggler_tick_cnt"),
+        "commits": per("txn_cnt"),
+        "aborts": per("total_txn_abort_cnt"),
+        "remote": per("remote_entry_cnt"),
+        "measured_ticks": int(np.asarray(stats["measured_ticks"]).max()),
+    }
+    if "arr_mesh_inflight" in stats:
+        snap["inflight"] = np.asarray(stats["arr_mesh_inflight"])
+    return snap
+
+
+def reconcile(snap: dict, summary: dict) -> list:
+    """The exact identities, as ``(what, got, want)`` mismatch tuples
+    (empty == all good; tests + the check.sh mesh smoke gate)."""
+    bad = []
+    tx, rx = snap["tx"], snap["rx"]
+    # both ends of every exchange counted the same delivered lanes
+    if not np.array_equal(tx, np.transpose(rx, (1, 0, 2))):
+        diff = int(np.abs(tx.astype(np.int64)
+                          - np.transpose(rx, (1, 0, 2))).sum())
+        bad.append(("tx_rx_transpose_absdiff", diff, 0))
+    # one decision word home per delivered exchange-A entry, per pair
+    a_pair = tx[:, :, REQ] + tx[:, :, PREP] + tx[:, :, EPOCH]
+    if not np.array_equal(tx[:, :, RESP], a_pair.T):
+        bad.append(("response_mirror", int(tx[:, :, RESP].sum()),
+                    int(a_pair.sum())))
+    # attempted == delivered + dropped, per node
+    if snap["remote"] is not None and snap["drops"] is not None:
+        attempts = (tx[:, :, (REQ, PREP, EPOCH)].sum(axis=(1, 2))
+                    + snap["drops"])
+        for i in range(snap["nodes"]):
+            if int(attempts[i]) != int(snap["remote"][i]):
+                bad.append((f"remote_entry[{i}]", int(attempts[i]),
+                            int(snap["remote"][i])))
+    # in-transit population sums to the per-message queue-time integral
+    if "inflight" in snap and "lat_msg_queue_time" in summary:
+        got = int(snap["inflight"].sum())
+        want = int(summary["lat_msg_queue_time"])
+        if got != want:
+            bad.append(("msg_queue_population", got, want))
+    # the summary's cluster totals match the fetched planes
+    if "mesh_tx_total" in summary:
+        got = int(tx.sum())
+        if got != int(summary["mesh_tx_total"]):
+            bad.append(("summary_tx_total", got,
+                        int(summary["mesh_tx_total"])))
+    return bad
+
+
+def cluster_matrix(jax_mesh, tx_stacked) -> np.ndarray:
+    """Device-side psum of the per-node tx planes over the node axis in
+    one jitted shard_map — bit-exact equal to the host
+    ``tx_stacked.sum(axis=0)`` ((N, T) per-dest per-type totals)."""
+    from jax.sharding import PartitionSpec as P
+    from deneva_tpu.compat import shard_map
+    axis = jax_mesh.axis_names[0]
+    spec = P(axis)
+
+    def agg(tx):
+        return jax.lax.psum(tx[0], axis)[None]
+
+    f = jax.jit(shard_map(agg, mesh=jax_mesh, in_specs=(spec,),
+                          out_specs=spec))
+    return np.asarray(f(tx_stacked))[0]
+
+
+def imbalance(snap: dict) -> dict:
+    """Jain's indices over the per-node load planes plus the straggler
+    attribution (which node topped exchange occupancy most often)."""
+    out = {"imb_jain": jain(snap["commits"])
+           if snap["commits"] is not None else 1.0}
+    if snap["occ_sum"] is not None:
+        out["imb_jain_occ"] = jain(snap["occ_sum"])
+    if snap["straggler"] is not None:
+        out["straggler_node"] = int(np.argmax(snap["straggler"]))
+        out["straggler_ticks"] = int(snap["straggler"].max())
+    return out
+
+
+def mesh_report(snap: dict, cap: int | None = None, topk: int = 8) -> dict:
+    """The machine-readable ``[mesh]`` section (obs/report.py renders
+    it): per-type cluster totals, the (N, N) volume matrix, the top
+    traffic pairs, the per-node load planes and the imbalance block."""
+    tx = snap["tx"]
+    N = snap["nodes"]
+    vol = tx.sum(axis=2)                      # (N, N) messages i -> j
+    order = np.argsort(-vol, axis=None)
+    pairs = []
+    for k in order[:topk]:
+        i, j = int(k) // N, int(k) % N
+        if vol[i, j] <= 0:
+            break
+        pairs.append({"src": i, "dst": j, "msgs": int(vol[i, j])})
+    ticks = max(snap["measured_ticks"], 1)
+    per_node = {}
+    for key in ("commits", "aborts", "remote", "occ_peak", "straggler"):
+        if snap.get(key) is not None:
+            per_node[key] = [int(v) for v in snap[key]]
+    if snap.get("occ_sum") is not None:
+        per_node["occ_avg"] = [round(float(v) / ticks, 2)
+                               for v in snap["occ_sum"]]
+    out = {
+        "nodes": N,
+        "ticks": snap["measured_ticks"],
+        "by_type": {name: int(tx[:, :, i].sum())
+                    for i, name in enumerate(MSG_TYPES)},
+        "matrix": vol.astype(int).tolist(),
+        "top_pairs": pairs,
+        "per_node": per_node,
+        "drops": int(snap["drops"].sum())
+        if snap.get("drops") is not None else 0,
+        "imbalance": imbalance(snap),
+    }
+    if "inflight" in snap:
+        out["inflight"] = {name: int(snap["inflight"].sum(axis=0)[i])
+                           for i, name in enumerate(MSG_TYPES)}
+    if cap is not None:
+        out["cap"] = int(cap)
+    return out

@@ -27,7 +27,10 @@ Open-system sweep records (bench.py ``--offered-load``) join the same
 trajectory under their own ``offered_load_knee`` metric and
 ``<ALG>@knee`` cells; their per-algorithm saturation knee is gated like
 commits_per_tick (a knee collapse = the engine saturates earlier than it
-used to).
+used to).  Cluster scaling-grid records (bench.py ``--scaling-grid``)
+likewise gate each ``<ALG>@<nodes>x<batch>`` cell's parallel efficiency
+at the same tolerance (an efficiency collapse = the cluster scales worse
+at that point than it used to).
 
 A gate with no prior data (e.g. per-alg cells first appeared in round 5)
 is SKIPPED with a note, not failed — the gate self-arms as history
@@ -101,6 +104,17 @@ def _entry(source: str, order: tuple, doc: dict) -> Optional[dict]:
     out["knees"] = knees
     if "offered_load" in doc:
         out["offered_load"] = doc["offered_load"]
+    # cluster scaling-grid records (bench.py --scaling-grid) carry one
+    # parallel-efficiency cell per (alg, nodes, batch) grid point; same
+    # normalize-to-empty discipline, so the efficiency gate self-arms
+    grid = {}
+    for cell_key, cell in (doc.get("scaling_grid") or {}).items():
+        try:
+            grid[cell_key] = float(cell.get("efficiency")
+                                   if isinstance(cell, dict) else cell)
+        except (TypeError, ValueError):
+            continue
+    out["scaling_grid"] = grid
     return out
 
 
@@ -221,6 +235,16 @@ def gate(entries: list[dict], current: Optional[dict] = None,
         check(f"offered_load_knee[{alg}]", cur,
               [e["knees"][alg] for e in prior
                if alg in e.get("knees", {})],
+              cpt_tolerance)
+    # scaling-grid trajectory (--scaling-grid records): a grid cell's
+    # parallel efficiency collapsing means the cluster scales worse at
+    # that (alg, nodes, batch) point than it used to — schedule-pure
+    # like commits_per_tick, so it shares that tolerance and self-arms
+    # once the trajectory carries the cell
+    for cell_key, cur in sorted(current.get("scaling_grid", {}).items()):
+        check(f"scaling_grid_efficiency[{cell_key}]", cur,
+              [e["scaling_grid"][cell_key] for e in prior
+               if cell_key in e.get("scaling_grid", {})],
               cpt_tolerance)
     return {"current": current, "checks": checks, "failures": failures,
             "skipped": skipped}

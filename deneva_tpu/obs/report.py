@@ -17,15 +17,20 @@ Everything renders twice: :func:`render_text` for terminals and
 :func:`build_report` for machines (plain-JSON-serializable dict).
 
 The watchdog (:func:`watchdog`) turns the same inputs into CI-grade
-findings with a process exit bitmask::
+findings with a process exit bitmask.  This block is THE definition of
+the full mask (the README watchdog table mirrors it)::
 
-    RECONCILE (1)  counters fail their exact identities
-    LIVELOCK  (2)  a zero-commit window with live abort/admission churn
-    SPILL     (4)  compaction spill storm (forced-retry pressure)
-    STARVED   (8)  a shard committing nothing while the cluster commits
-    OVERLOAD (16)  open-system run ended with more than ~1 service
-                   tick of admission backlog still queued (offered
-                   load exceeded the saturation knee and never drained)
+    RECONCILE  (1)  counters fail their exact identities
+    LIVELOCK   (2)  a zero-commit window with live abort/admission churn
+    SPILL      (4)  compaction spill storm (forced-retry pressure)
+    STARVED    (8)  a shard committing nothing while the cluster commits
+    OVERLOAD  (16)  open-system run ended with more than ~1 service
+                    tick of admission backlog still queued (offered
+                    load exceeded the saturation knee and never drained)
+    IMBALANCE (32)  mesh runs: Jain's fairness over per-node commit
+                    loads fell below IMB_JAIN_MIN (obs/mesh.py) while
+                    the cluster was committing — more than half the
+                    nodes effectively idle
 
 CLI: ``python -m deneva_tpu.obs.report <run_record.json> [--json]``
 exits with the watchdog bitmask, so a CI stage can gate on it
@@ -38,12 +43,14 @@ import json
 
 import numpy as np
 
-# watchdog finding flags (process exit bitmask)
+# watchdog finding flags (process exit bitmask; the module docstring
+# above is the single documentation point for the full mask)
 RECONCILE = 1
 LIVELOCK = 2
 SPILL = 4
 STARVED = 8
 OVERLOAD = 16
+IMBALANCE = 32
 
 #: a zero-commit run of at least this many ticks, with abort/admission
 #: churn inside it, is flagged as live-lock
@@ -151,7 +158,8 @@ def hot_keys(stats: dict, topk: int = 8) -> list:
 def build_report(summary: dict, timeline: dict | None = None,
                  stats: dict | None = None, topk: int = 8,
                  xmeter: dict | None = None,
-                 flight: dict | None = None) -> dict:
+                 flight: dict | None = None,
+                 mesh: dict | None = None) -> dict:
     """The machine-readable waterfall: phases (slot-ticks + share),
     throughput, the abort taxonomy, hot keys / per-partition conflicts /
     wait-depth histogram (when the run kept a heatmap), reconciliation
@@ -203,17 +211,24 @@ def build_report(summary: dict, timeline: dict | None = None,
     if flight is not None:
         from deneva_tpu.obs.flight import tail_attribution
         rep["tail"] = tail_attribution(flight, topk=topk)
+    if mesh is not None:
+        # the [mesh] section: pass an obs/mesh.py mesh_report dict (or a
+        # run record's "mesh" field) — per-node-pair traffic volumes,
+        # type breakdown, load planes and the imbalance block
+        rep["mesh"] = mesh
     rep["reconcile_failures"] = reconcile(summary, timeline)
     findings, code = watchdog(summary, timeline,
-                              precomputed_reconcile=rep["reconcile_failures"])
+                              precomputed_reconcile=rep["reconcile_failures"],
+                              mesh=mesh)
     rep["watchdog"] = {"exit_code": code, "findings": findings}
     return rep
 
 
 def watchdog(summary: dict, timeline: dict | None = None,
-             precomputed_reconcile: list | None = None) -> tuple:
+             precomputed_reconcile: list | None = None,
+             mesh: dict | None = None) -> tuple:
     """(findings, exit_bitmask).  Each finding is ``(FLAG_NAME, message)``;
-    the bitmask ORs RECONCILE/LIVELOCK/SPILL/STARVED/OVERLOAD."""
+    the bitmask ORs the flags the module docstring defines."""
     findings = []
     code = 0
 
@@ -285,6 +300,27 @@ def watchdog(summary: dict, timeline: dict | None = None,
                              f"commits/tick (peak={int(summary.get('queue_peak', 0))}, "
                              f"arrivals={int(summary.get('arrival_cnt', 0))})"))
             code |= OVERLOAD
+
+    # mesh-run shard imbalance: Jain over per-node commit loads (from the
+    # [mesh] section when given, else the summary's imb_jain key — both
+    # exist only for Config.mesh runs, so other summaries skip this)
+    from deneva_tpu.obs.mesh import IMB_JAIN_MIN
+    jain_v = None
+    if mesh is not None:
+        jain_v = float(mesh.get("imbalance", {}).get("imb_jain", 1.0))
+    elif "imb_jain" in summary:
+        jain_v = float(summary["imb_jain"])
+    if jain_v is not None and commits > 0 and jain_v < IMB_JAIN_MIN:
+        strag = ""
+        if mesh is not None:
+            imb = mesh.get("imbalance", {})
+            if "straggler_node" in imb:
+                strag = (f" (straggler node {imb['straggler_node']}, "
+                         f"{imb.get('straggler_ticks', 0)} peak ticks)")
+        findings.append(
+            ("IMBALANCE", f"Jain fairness {jain_v:.3f} < {IMB_JAIN_MIN} "
+                          f"over per-node commit loads{strag}"))
+        code |= IMBALANCE
     return findings, code
 
 
@@ -364,6 +400,35 @@ def render_text(rep: dict) -> str:
                 lines.append(f"  tail-key   {key:<20} {c}")
         else:
             lines.append("[tail] no completed spans sampled")
+    if rep.get("mesh") is not None:
+        m = rep["mesh"]
+        total = sum(m["by_type"].values())
+        lines.append(
+            f"[mesh] {m['nodes']}-node traffic matrix: {total} messages "
+            f"over {m['ticks']} ticks (drops={m['drops']})")
+        by_type = "  ".join(f"{name}={cnt}"
+                            for name, cnt in m["by_type"].items() if cnt > 0)
+        lines.append("  types  " + (by_type or "(no cross-node traffic)"))
+        for p in m.get("top_pairs", []):
+            lines.append(f"  pair {p['src']}->{p['dst']:<3} "
+                         f"{p['msgs']:>10} msgs")
+        imb = m.get("imbalance", {})
+        imb_line = f"  imbalance jain={imb.get('imb_jain', 1.0):.3f}"
+        if "imb_jain_occ" in imb:
+            imb_line += f" (occupancy {imb['imb_jain_occ']:.3f})"
+        if "straggler_node" in imb:
+            imb_line += (f"; straggler node {imb['straggler_node']} "
+                         f"({imb.get('straggler_ticks', 0)} peak ticks)")
+        lines.append(imb_line)
+        pn = m.get("per_node", {})
+        if "commits" in pn:
+            lines.append("  node commits " + " ".join(
+                str(v) for v in pn["commits"]))
+        if "occ_avg" in pn:
+            cap = f" (cap {m['cap']})" if "cap" in m else ""
+            lines.append("  exchange occupancy avg " + " ".join(
+                str(v) for v in pn["occ_avg"])
+                + f", peak {max(pn.get('occ_peak', [0]))}{cap}")
     for flag, msg in rep["watchdog"]["findings"]:
         lines.append(f"[watchdog] {flag}: {msg}")
     if not rep["watchdog"]["findings"]:
@@ -376,7 +441,8 @@ def report_from_record(rec: dict) -> dict:
     (obs/profiler.py write_run_record)."""
     return build_report(rec["summary"], rec.get("timeline"),
                         xmeter=rec.get("xmeter"),
-                        flight=rec.get("flight"))
+                        flight=rec.get("flight"),
+                        mesh=rec.get("mesh"))
 
 
 def main(argv=None) -> int:

@@ -160,6 +160,13 @@ def _queue_buffer(state_or_stats) -> np.ndarray | None:
     return np.asarray(stats["arr_queue_trace"])
 
 
+def _mesh_buffer(state_or_stats) -> np.ndarray | None:
+    stats = getattr(state_or_stats, "stats", state_or_stats)
+    if "arr_mesh_trace" not in stats:
+        return None
+    return np.asarray(stats["arr_mesh_trace"])
+
+
 def _reason_names() -> tuple:
     from deneva_tpu.cc.base import ABORT_REASONS
     return tuple(f"abort_{name}" for name in ABORT_REASONS)
@@ -170,14 +177,18 @@ def timeline(state_or_stats, per_shard: bool = False) -> dict:
     sum the node axis for the cluster-wide view unless ``per_shard``,
     which keeps them ``(N, T)``).  Runs traced with
     ``Config.abort_attribution`` additionally carry one ``abort_<reason>``
-    series per registered reason code."""
+    series per registered reason code; mesh-observatory runs
+    (``Config.mesh`` with tracing) one ``mesh_tx_to<j>`` series per
+    destination node (messages shipped toward node j that tick)."""
     a = _buffer(state_or_stats)
     r = _reason_buffer(state_or_stats)
     q = _queue_buffer(state_or_stats)
+    m = _mesh_buffer(state_or_stats)      # stacked: (N, trace_ticks, N)
     if a.ndim == 3 and not per_shard:
         a = a.sum(axis=0)
         r = r.sum(axis=0) if r is not None else None
         q = q.sum(axis=0) if q is not None else None
+        m = m.sum(axis=0) if m is not None else None
     if a.ndim == 3:
         out = {name: a[:, :, i] for i, name in enumerate(TRACE_COLUMNS)}
         if r is not None:
@@ -185,6 +196,9 @@ def timeline(state_or_stats, per_shard: bool = False) -> dict:
                         for i, name in enumerate(_reason_names())})
         if q is not None:
             out["queue_depth"] = q
+        if m is not None:
+            out.update({f"mesh_tx_to{j}": m[:, :, j]
+                        for j in range(m.shape[-1])})
         return out
     out = {name: a[:, i] for i, name in enumerate(TRACE_COLUMNS)}
     if r is not None:
@@ -192,6 +206,8 @@ def timeline(state_or_stats, per_shard: bool = False) -> dict:
                     for i, name in enumerate(_reason_names())})
     if q is not None:
         out["queue_depth"] = q
+    if m is not None:
+        out.update({f"mesh_tx_to{j}": m[:, j] for j in range(m.shape[-1])})
     return out
 
 
@@ -242,6 +258,10 @@ def to_chrome_trace(state_or_stats, path: str, n_ticks: int | None = None,
     qshards = None
     if qbuf is not None:
         qshards = qbuf[None] if qbuf.ndim == 1 else qbuf
+    mbuf = _mesh_buffer(state_or_stats)
+    mshards = None
+    if mbuf is not None:
+        mshards = mbuf[None] if mbuf.ndim == 2 else mbuf
     rnames = _reason_names()
     N, T, _ = shards.shape
     if n_ticks is not None:
@@ -281,6 +301,17 @@ def to_chrome_trace(state_or_stats, path: str, n_ticks: int | None = None,
                                "ts": ts, "pid": node,
                                "args": {"queue_depth":
                                         int(qshards[node][t])}})
+            if mshards is not None:
+                # 7th counter track (same conditional discipline): per
+                # node-pair traffic of mesh-observatory runs — one
+                # counter per destination node, this shard's outbound
+                # messages toward it that tick
+                events.append({"name": "mesh traffic", "ph": "C",
+                               "ts": ts, "pid": node,
+                               "args": {f"to{j}":
+                                        int(mshards[node][t, j])
+                                        for j in
+                                        range(mshards.shape[-1])}})
     xentries = []
     if xmeter:
         # 5th counter track, present only when an xmeter snapshot is
@@ -313,6 +344,8 @@ def to_chrome_trace(state_or_stats, path: str, n_ticks: int | None = None,
         doc["metadata"]["reason_columns"] = list(rnames)
     if qshards is not None:
         doc["metadata"]["queue_track"] = True
+    if mshards is not None:
+        doc["metadata"]["mesh_track_nodes"] = int(mshards.shape[-1])
     if xentries:
         doc["metadata"]["xmeter_entries"] = xentries
     if flight:

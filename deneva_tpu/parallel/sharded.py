@@ -62,6 +62,7 @@ from deneva_tpu.engine.scheduler import (STAT_KEYS_F32, STAT_KEYS_I32,  # noqa: 
                                          track_parts_touched,
                                          track_state_latencies)
 from deneva_tpu.obs import flight as obs_flight
+from deneva_tpu.obs import mesh as obs_mesh
 from deneva_tpu.obs import trace as obs_trace
 from deneva_tpu.obs.prog import ProgressEmitter
 from deneva_tpu.obs.profiler import PhaseProfiler
@@ -390,8 +391,20 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
         stats = bump(stats, "remote_entry_cnt",
                      jnp.sum((live_e & ~local_e).astype(jnp.int32)),
                      measuring)
+        # mesh observatory: delivered + dropped partition the attempted
+        # remote entries exactly, so the tx row reconciles against the
+        # remote_entry_cnt bump above (obs/mesh.py; no-op when off)
+        stats, mesh_per_dest = obs_mesh.note_exchange_a(
+            stats, dest, live_e & ~local_e & ~overflow, overflow,
+            fin2.reshape(-1), plugin.epoch_admission, n_nodes, measuring)
+        stats = obs_mesh.note_occupancy(stats, mesh_per_dest, AXIS,
+                                        measuring)
 
         recv = routing.exchange(send, AXIS)
+        # rx mirror at the owner: the same delivered lanes, counted at
+        # the receiving end (live == key shipped, fin split via bit 3)
+        stats = obs_mesh.note_owner_rx(stats, recv["key"], recv["flags"],
+                                       plugin.epoch_admission, measuring)
 
         # ---- 3. owner side: virtual txns -> plugin kernels ----
         # lanes [0, N*cap): received remote entries; [N*cap, N*cap+nE):
@@ -650,6 +663,18 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
                             + jnp.sum(in_resp_e.astype(jnp.int32))
                             + jnp.sum((in_abt | in_fin
                                        | in_vote).astype(jnp.int32)))
+            # mesh: the same population split by type — abort decisions
+            # are response-class words in transit home; prepare covers
+            # the 2PC fin requests and vote words.  The three terms sum
+            # to msg_wait_cnt exactly (in_abt + (fin|vote)&~abt ==
+            # abt|fin|vote), so the inflight plane reconciles against
+            # the lat_msg_queue_time integral bit-exact.
+            stats = obs_mesh.note_inflight(
+                stats, jnp.sum(in_req_e.astype(jnp.int32)),
+                jnp.sum(in_resp_e.astype(jnp.int32))
+                + jnp.sum(in_abt.astype(jnp.int32)),
+                jnp.sum(((in_fin | in_vote) & ~in_abt).astype(jnp.int32)),
+                measuring)
         else:
             abort_now = (blocked & at_fail(abort_e)) | vabort
 
@@ -730,6 +755,12 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
                     n_nodes, cap)
 
         recvB = routing.exchange(sendB, AXIS)
+        # mesh: delivered commit-effect entries at both ends (a deferred
+        # txn's packed entries DID travel; the owner drops them via the
+        # commit flag, not the wire)
+        stats = obs_mesh.note_commit_exchange(
+            stats, dest, commit_e & ~local_e & ~ovfB, recvB["key"],
+            measuring)
         # owner view = received remote commit entries + my own local ones
         # (local lanes use the FINAL commit/final masks directly — no
         # re-gather needed, they never packed)
@@ -848,6 +879,21 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
                     perm = [(i, (i + 1) % n_nodes) for i in range(n_nodes)]
                     rrecs = jax.lax.ppermute(recs, AXIS, perm)
                     rlive = rrecs != NULL_KEY
+                # mesh: per-record replication traffic at both ends of
+                # the ppermute (the scalar ack ppermutes below are NOT
+                # messages); AP replicas send nothing — their index
+                # clamps to n_nodes and drops
+                if cfg.repl_mode == "ap":
+                    mesh_dst = jnp.where(node_id < n_parts,
+                                         node_id + n_parts, n_nodes)
+                    mesh_src = jnp.where(node_id >= n_parts,
+                                         node_id - n_parts, n_nodes)
+                else:
+                    mesh_dst = (node_id + 1) % n_nodes
+                    mesh_src = (node_id + n_nodes - 1) % n_nodes
+                stats = obs_mesh.note_repl(
+                    stats, mesh_dst, jnp.sum(wflat.astype(jnp.int32)),
+                    mesh_src, jnp.sum(rlive.astype(jnp.int32)), measuring)
                 rrank = jnp.cumsum(rlive.astype(jnp.int32)) - rlive.astype(
                     jnp.int32)
                 n_r = jnp.sum(rlive.astype(jnp.int32))
@@ -1022,6 +1068,9 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
                 live_entries=live_delta, compact_ovf=ovf_delta)
             stats = obs_trace.record_reasons(stats, t)
             stats = obs_trace.record_queue(stats, t)
+            # per-dest sent counts into the mesh companion ring (the
+            # per-node-pair Perfetto counter tracks; obs/mesh.py)
+            stats = obs_mesh.note_trace(stats, t, mesh_per_dest)
         if dly:
             # with a real delay model, network time is the per-tick count
             # of txns blocked purely on message transit (integrates to
@@ -1200,7 +1249,10 @@ class ShardedEngine:
                        # exists only then (single-shard carries nothing —
                        # deneva_tpu/stats.py defaults the absent key to 0)
                        **({"lat_msg_queue_time": jnp.zeros((), jnp.float32)}
-                          if cfg.net_delay_ticks > 0 else {})},
+                          if cfg.net_delay_ticks > 0 else {}),
+                       # mesh observatory planes ({} when Config.mesh
+                       # is off — the default carries nothing)
+                       **obs_mesh.init_mesh(cfg, N)},
                 tick=jnp.zeros((), jnp.int32),
                 pool_cursor=jnp.zeros((), jnp.int32),
                 ts_counter=jnp.ones((), jnp.int32),
@@ -1367,7 +1419,26 @@ class ShardedEngine:
             # sums every shard's replica)
             out.update(self.xmeter.summary_fields(
                 hbm_bytes=ledger_totals(self.ledger(state))["total"]))
+        if "arr_mesh_tx" in state.stats:
+            # mesh observatory (byte-identical off path): the four int
+            # counters already rode the psum above; add the host-side
+            # cluster matrix total and the Jain's-fairness index over
+            # the per-node commit loads (obs/mesh.py MESH_SUMMARY_KEYS)
+            out["mesh_tx_total"] = int(
+                np.asarray(state.stats["arr_mesh_tx"]).sum())
+            out["imb_jain"] = obs_mesh.jain(
+                np.asarray(state.stats["txn_cnt"]))
         return out
+
+    def mesh_snapshot(self, state: ShardState) -> dict:
+        """Host-side mesh observatory snapshot (obs/mesh.py)."""
+        return obs_mesh.snapshot(state)
+
+    def mesh_cluster_matrix(self, state: ShardState) -> np.ndarray:
+        """Device-psum'd (N, T) per-dest/per-type traffic totals —
+        bit-exact equal to the host sum of the per-node tx planes."""
+        return obs_mesh.cluster_matrix(self.mesh,
+                                       state.stats["arr_mesh_tx"])
 
     def ledger(self, state: ShardState) -> list:
         """Cluster HBM footprint rows (obs/xmeter.py state_ledger): the

@@ -146,6 +146,15 @@ def reference_summary(s: dict, wall_seconds: float | None = None) -> dict:
     for k in sorted(s):
         if k.startswith("flight_") and k not in out:
             out[k] = s[k]
+    # mesh observatory keys (Config.mesh, obs/mesh.py): traffic-matrix
+    # totals / drops / occupancy planes / straggler counts plus the
+    # imb_jain fairness index pass through verbatim (counts and a
+    # dimensionless index — never time-scaled).  Present only for
+    # sharded mesh runs, so the default line stays byte-identical.
+    _MESH_PREFIXES = ("mesh_", "imb_", "straggler_")
+    for k in sorted(s):
+        if k.startswith(_MESH_PREFIXES) and k not in out:
+            out[k] = s[k]
     for k in sorted(s):
         if k.startswith("famlat") and k not in out:
             out[k] = s[k] * tick_sec if isinstance(s[k], float) else s[k]

@@ -158,6 +158,50 @@ if [ "$fused_rc" -ne 0 ]; then
     exit "$fused_rc"
 fi
 
+echo "== mesh smoke (traffic matrix + reconciliation) =="
+# the cluster mesh observatory (deneva_tpu/obs/mesh.py) on a 4-node
+# virtual-device dryrun: the [mesh] report section must render, and the
+# N x N x type traffic matrix must reconcile EXACTLY against
+# remote_entry_cnt (attempted == delivered + dropped), transpose to the
+# rx planes, and mirror one response per delivered entry; the psum'd
+# cluster matrix must equal the numpy sum of the per-node planes
+env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+    python - <<'PYEOF'
+import numpy as np
+from deneva_tpu.config import Config
+from deneva_tpu.obs import mesh as obs_mesh
+from deneva_tpu.obs import report as obs_report
+from deneva_tpu.parallel.sharded import ShardedEngine
+
+cfg = Config(cc_alg="WAIT_DIE", node_cnt=4, part_cnt=4, batch_size=32,
+             synth_table_size=1 << 12, req_per_query=4,
+             query_pool_size=1 << 10, zipf_theta=0.6, tup_read_perc=0.5,
+             warmup_ticks=0, mpr=1.0, part_per_txn=2, mesh=True)
+eng = ShardedEngine(cfg)
+st = eng.run(40)
+s = eng.summary(st)
+snap = eng.mesh_snapshot(st)
+bad = obs_mesh.reconcile(snap, s)
+assert bad == [], f"mesh matrix failed to reconcile: {bad}"
+cm = np.asarray(eng.mesh_cluster_matrix(st))
+tx = np.asarray(st.stats["arr_mesh_tx"])
+assert np.array_equal(cm, tx.sum(axis=0, dtype=np.int32)), \
+    "psum cluster matrix != sum of per-node planes"
+rep = obs_report.build_report(s, mesh=obs_mesh.mesh_report(snap,
+                                                           cap=eng.cap))
+text = obs_report.render_text(rep)
+assert "[mesh]" in text, "report missing the [mesh] section"
+print(next(ln for ln in text.splitlines() if ln.startswith("[mesh]")))
+print(f"[mesh] reconciled: {s['mesh_tx_total']} msgs, "
+      f"jain={s['imb_jain']:.3f}")
+PYEOF
+mesh_rc=$?
+if [ "$mesh_rc" -ne 0 ]; then
+    echo "mesh smoke FAILED (reconcile/report rc=$mesh_rc)"
+    exit "$mesh_rc"
+fi
+
 echo "== bench regression gate =="
 # gate the latest trajectory point (committed BENCH_r*.json snapshots +
 # any results/bench_history.jsonl) against the median of its priors;

@@ -1,0 +1,307 @@
+"""Cluster mesh observatory tests (deneva_tpu/obs/mesh.py).
+
+The traffic matrix is an accounting identity, not an estimate — with
+``Config.mesh`` on, every cell of the N x N x type tensor reconciles
+EXACTLY against the engine's own counters (attempted == delivered +
+dropped against ``remote_entry_cnt``; tx == rx transposed; one response
+word per delivered request; in-flight planes against
+``lat_msg_queue_time``), for every CC plugin and replication topology.
+The off path (``Config.mesh=False``, the default) must carry zero extra
+device arrays and leave the ``[summary]`` line byte-identical; the on
+path must hold the zero post-warmup recompile sentinel.
+
+Sharded compiles dominate the cost, so deterministic cells are cached
+module-wide and shared across tests (same config -> same schedule).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from deneva_tpu.config import Config
+from deneva_tpu.obs import mesh as obs_mesh
+from deneva_tpu.obs import trace as obs_trace
+from deneva_tpu.obs.mesh import MESH_SUMMARY_KEYS, MSG_TYPES
+from deneva_tpu.parallel.sharded import ShardedEngine
+
+BASE = dict(node_cnt=2, part_cnt=2, batch_size=32,
+            synth_table_size=1 << 12, req_per_query=4,
+            query_pool_size=1 << 10, zipf_theta=0.6, tup_read_perc=0.5,
+            warmup_ticks=0, mpr=1.0, part_per_txn=2)
+
+ALGS = ["NO_WAIT", "WAIT_DIE", "TIMESTAMP", "MVCC", "OCC", "MAAT",
+        "CALVIN"]
+
+#: the exact device-array surface the observatory adds (keep in sync
+#: with obs/mesh.py init_mesh — the off-path purity test asserts the
+#: set).  ``arr_mesh_inflight`` joins only for net_delay runs and
+#: ``arr_mesh_trace`` only for traced runs.
+MESH_STATS_KEYS = {
+    "arr_mesh_tx", "arr_mesh_rx", "mesh_drop_cnt", "mesh_occ_sum",
+    "mesh_occ_peak", "straggler_tick_cnt",
+}
+
+_cells = {}
+
+
+def cell(alg, mesh=True, **kw):
+    """Run (and cache) one deterministic sharded cell; returns
+    (engine, state, summary)."""
+    key = (alg, mesh, tuple(sorted(kw.items())))
+    if key not in _cells:
+        cfg = Config(cc_alg=alg, mesh=mesh, **{**BASE, **kw})
+        eng = ShardedEngine(cfg)
+        st = eng.run(40)
+        _cells[key] = (eng, st, eng.summary(st))
+    return _cells[key]
+
+
+# tier-1 keeps one lock-pair cell (WAIT_DIE) and the epoch-exchange
+# outlier (CALVIN); the remaining plugins recheck the same two cells
+# under `-m slow` per the tier-1 budget split, mirroring test_flight
+_SLOW_ALGS = [pytest.param(a, marks=pytest.mark.slow)
+              for a in ("NO_WAIT", "TIMESTAMP", "MVCC", "OCC", "MAAT")]
+
+
+@pytest.mark.parametrize("alg", ["WAIT_DIE", "CALVIN"] + _SLOW_ALGS)
+def test_mesh_off_is_byte_identical_and_carries_nothing(alg):
+    """mesh=False (default): zero extra device arrays, zero summary
+    keys; mesh=True adds EXACTLY the documented surface and leaves the
+    schedule untouched."""
+    eng_off, st_off, s_off = cell(alg, mesh=False)
+    assert not any("mesh" in k for k in st_off.stats)
+    line = eng_off.summary_line(st_off)
+    assert "mesh" not in line and "imb_jain" not in line
+
+    _, st_on, s_on = cell(alg, mesh=True)
+    assert set(st_on.stats) - set(st_off.stats) == MESH_STATS_KEYS
+    # the schedule itself is untouched — same commits, same aborts
+    for k in ("txn_cnt", "total_txn_abort_cnt", "local_txn_start_cnt",
+              "remote_entry_cnt"):
+        assert s_on[k] == s_off[k], (k, s_on[k], s_off[k])
+    # summary gains only the documented keys (arr_ keys are skipped)
+    assert set(s_on) - set(s_off) == set(MESH_SUMMARY_KEYS)
+
+
+@pytest.mark.slow  # second identical compile; tier-1 budget split
+def test_mesh_off_line_is_reproducible():
+    """Rerunning the identical mesh-off config reproduces the summary
+    line byte for byte (modulo host-process utilization keys)."""
+    eng, st, _ = cell("WAIT_DIE", mesh=False)
+
+    def engine_bytes(ln):
+        return ",".join(p for p in ln.split(",")
+                        if not p.startswith(("mem_util=", "cpu_util=")))
+
+    cfg = Config(cc_alg="WAIT_DIE", mesh=False, **BASE)
+    eng2 = ShardedEngine(cfg)
+    st2 = eng2.run(40)
+    assert (engine_bytes(eng2.summary_line(st2))
+            == engine_bytes(eng.summary_line(st)))
+
+
+@pytest.mark.parametrize("alg", ["WAIT_DIE", "CALVIN"] + _SLOW_ALGS)
+def test_matrix_reconciles_exactly(alg):
+    """Row/col sums against remote_entry_cnt, tx == rx transposed, one
+    response per delivered entry, and the summary total — per plugin."""
+    eng, st, s = cell(alg, mesh=True)
+    snap = eng.mesh_snapshot(st)
+    assert obs_mesh.reconcile(snap, s) == []
+    assert s["mesh_tx_total"] > 0
+    # Calvin rides the epoch lane, lock-based plugins the request lane
+    tx = snap["tx"]
+    if alg == "CALVIN":
+        assert tx[:, :, obs_mesh.EPOCH].sum() > 0
+        assert tx[:, :, obs_mesh.REQ].sum() == 0
+    else:
+        assert tx[:, :, obs_mesh.REQ].sum() > 0
+        assert tx[:, :, obs_mesh.EPOCH].sum() == 0
+    assert tx[:, :, obs_mesh.RESP].sum() > 0
+
+
+@pytest.mark.slow  # extra warmup-variant compile; tier-1 budget split
+def test_matrix_reconciles_with_warmup():
+    """The accumulation gate mirrors the bump() warmup gate on every
+    leg, so the identities hold for ANY warmup."""
+    eng, st, s = cell("WAIT_DIE", mesh=True, warmup_ticks=10)
+    assert s["measured_ticks"] < 40
+    snap = eng.mesh_snapshot(st)
+    assert obs_mesh.reconcile(snap, s) == []
+
+
+@pytest.mark.parametrize("alg", ["WAIT_DIE",
+                                 pytest.param("MAAT",
+                                              marks=pytest.mark.slow)])
+def test_inflight_reconciles_with_net_delay(alg):
+    """dly mode: the per-type in-flight planes decompose
+    lat_msg_queue_time exactly (REQ + RESP + PREP partition the transit
+    population) and the inflight arrays join the device surface."""
+    eng, st, s = cell(alg, mesh=True, net_delay_ticks=2)
+    assert "arr_mesh_inflight" in st.stats
+    assert s["lat_msg_queue_time"] > 0
+    snap = eng.mesh_snapshot(st)
+    assert obs_mesh.reconcile(snap, s) == []
+    # stacked (node, type) planes: total transit ticks == the integral
+    assert snap["inflight"].shape == (2, len(obs_mesh.MSG_TYPES))
+    assert int(snap["inflight"].sum()) == s["lat_msg_queue_time"]
+
+
+def test_cluster_matrix_is_sum_of_shards():
+    """The psum'd cluster matrix equals the numpy sum of the per-node
+    tx planes BIT-EXACTLY (int32 addition is associative)."""
+    eng, st, _ = cell("WAIT_DIE", mesh=True)
+    cm = np.asarray(eng.mesh_cluster_matrix(st))
+    tx = np.asarray(st.stats["arr_mesh_tx"])
+    assert cm.dtype == np.int32
+    assert np.array_equal(cm, tx.sum(axis=0, dtype=np.int32))
+
+
+def test_ap_replica_rows_all_zero():
+    """Active-passive: replicas never originate traffic — their tx rows
+    (and the matching rx columns outside the replication lane) are
+    all-zero, and the replication lane reconciles worker -> replica."""
+    eng, st, s = cell("WAIT_DIE", mesh=True, node_cnt=2, part_cnt=1,
+                      part_per_txn=1, repl_mode="ap", repl_cnt=2,
+                      logging=True)
+    snap = eng.mesh_snapshot(st)
+    assert obs_mesh.reconcile(snap, s) == []
+    tx = snap["tx"]
+    n_parts = 1
+    assert not tx[n_parts:].any()          # replica rows: silent
+    # workers DID replicate: the repl lane points worker -> replica
+    assert tx[:n_parts, :, obs_mesh.REPL].sum() > 0
+    # replicas commit nothing by design -> Jain sits at ~k/n == 0.5,
+    # still ABOVE the watchdog threshold (by-design asymmetry is clean)
+    assert s["imb_jain"] == pytest.approx(0.5, abs=0.02)
+    assert s["imb_jain"] >= obs_mesh.IMB_JAIN_MIN
+
+
+def test_jain_index_and_imbalance_bit():
+    """jain() algebra + the IMBALANCE (32) watchdog bit: balanced loads
+    sit at 1.0 and stay clean; a one-hot load fires."""
+    from deneva_tpu.obs import report as obs_report
+    assert obs_mesh.jain(np.array([5, 5, 5, 5])) == 1.0
+    assert obs_mesh.jain(np.zeros(4)) == 1.0       # vacuous balance
+    assert obs_mesh.jain(np.array([8, 0, 0, 0])) == pytest.approx(0.25)
+    clean = {"txn_cnt": 40, "imb_jain": 1.0}
+    _, code = obs_report.watchdog(clean)
+    assert not code & obs_report.IMBALANCE
+    skewed = {"txn_cnt": 40, "imb_jain": 0.25}
+    findings, code = obs_report.watchdog(skewed)
+    assert code & obs_report.IMBALANCE
+    assert any(f[0] == "IMBALANCE" for f in findings)
+
+
+def test_report_carries_mesh_section():
+    """build_report(mesh=...) renders the [mesh] section: totals,
+    by-type breakdown, top pairs and the imbalance line."""
+    from deneva_tpu.obs import report as obs_report
+    eng, st, s = cell("WAIT_DIE", mesh=True)
+    m = obs_mesh.mesh_report(eng.mesh_snapshot(st), cap=eng.cap)
+    rep = obs_report.build_report(s, mesh=m)
+    assert rep["mesh"] is m
+    text = obs_report.render_text(rep)
+    assert "[mesh]" in text
+    assert "imbalance jain=" in text
+    assert m["top_pairs"], "contended 2-node cell must cross the mesh"
+    # round-trips through a run record
+    rep2 = obs_report.report_from_record({"summary": s, "mesh": m})
+    assert rep2["mesh"] == m
+
+
+def test_zero_steady_recompiles_with_mesh_on():
+    """The observatory is jit-safe carried state: no shape depends on
+    data, so the xmeter sentinel must count ZERO post-warmup compiles."""
+    cfg = Config(cc_alg="WAIT_DIE", mesh=True, xmeter=True, **BASE)
+    eng = ShardedEngine(cfg)
+    st = eng.run(12)
+    eng.xmeter.mark_warm()
+    st = eng.run(12, st)
+    assert eng.xmeter.steady_violations() == []
+    assert obs_mesh.reconcile(eng.mesh_snapshot(st), eng.summary(st)) == []
+
+
+def test_trace_ring_and_perfetto_track(tmp_path):
+    """Traced mesh runs: the per-dest companion ring surfaces as
+    mesh_tx_to<j> timeline series (summing to the tx matrix row sums),
+    a "mesh traffic" Perfetto counter track, and the obs.export merge
+    path rebuilds the same track from a run record."""
+    eng, st, _ = cell("WAIT_DIE", mesh=True, trace_ticks=40)
+    assert "arr_mesh_trace" in st.stats
+    tl = obs_trace.timeline(st)
+    names = sorted(k for k in tl if k.startswith("mesh_tx_to"))
+    assert names == ["mesh_tx_to0", "mesh_tx_to1"]
+    # ring column sums == matrix row sums over every lane the ring sees
+    # (the per-dest ring counts A-exchange shipments; ticks 0..39, no
+    # wrap, warmup 0 -> equals the tx REQ+PREP+EPOCH attempt lanes
+    # minus drops, which this small cell never takes)
+    tx = np.asarray(st.stats["arr_mesh_tx"])
+    shipped = (tx[:, :, obs_mesh.REQ] + tx[:, :, obs_mesh.PREP]
+               + tx[:, :, obs_mesh.EPOCH]).sum(axis=0)
+    ring_sums = np.array([tl[n].sum() for n in names])
+    assert np.array_equal(ring_sums, shipped)
+
+    path = str(tmp_path / "tr.json")
+    obs_trace.to_chrome_trace(st, path, n_ticks=40)
+    doc = json.load(open(path))
+    assert doc["metadata"]["mesh_track_nodes"] == 2
+    mesh_evs = [e for e in doc["traceEvents"]
+                if e.get("name") == "mesh traffic"]
+    assert mesh_evs and set(mesh_evs[0]["args"]) == {"to0", "to1"}
+
+    from deneva_tpu.obs import export as obs_export
+    rec = {"timeline": {k: v.tolist() for k, v in
+                        obs_trace.timeline(st, per_shard=True).items()}}
+    evs = obs_export.record_events(rec)
+    merged = [e for e in evs if e.get("name") == "mesh traffic"]
+    assert merged and {e["pid"] for e in merged} == {0, 1}
+
+
+def test_snapshot_and_report_shapes():
+    """snapshot()/mesh_report() schema: (N, N, T) tensors, the type
+    axis order, and per-node planes sized N."""
+    eng, st, _ = cell("WAIT_DIE", mesh=True)
+    snap = eng.mesh_snapshot(st)
+    assert snap["tx"].shape == (2, 2, len(MSG_TYPES))
+    assert snap["rx"].shape == snap["tx"].shape
+    assert tuple(snap["types"]) == MSG_TYPES
+    m = obs_mesh.mesh_report(snap, cap=eng.cap)
+    assert len(m["matrix"]) == 2 and len(m["matrix"][0]) == 2
+    assert len(m["per_node"]["commits"]) == 2
+    assert m["cap"] == eng.cap
+    assert set(m["by_type"]) == set(MSG_TYPES)
+
+
+@pytest.mark.slow  # 8-node compiles x 2 shapes exceed the tier-1 budget
+def test_scaling_grid_cell(tmp_path):
+    """bench.py --scaling-grid: the 8-node MAAT cell lands in
+    scaling_grid.json with the speedup/efficiency/imbalance/remote-ratio
+    columns, reconciles, and the history record feeds the regress gate."""
+    import argparse
+
+    import bench
+    from deneva_tpu.obs import regress as obs_regress
+    args = argparse.Namespace(ticks=40, algs="MAAT", grid_nodes="4,8",
+                              grid_budget_mb=256.0, grid_max_batch=64)
+    out = str(tmp_path)
+    assert bench.run_scaling_grid(args, out_dir=out, history=True) == 0
+    doc = json.load(open(f"{out}/scaling_grid.json"))
+    cells = doc["grid"]["MAAT"]
+    assert {c["nodes"] for c in cells} == {4, 8}
+    for c in cells:
+        assert set(c) >= {"nodes", "batch_per_node", "commits_per_tick",
+                          "speedup", "efficiency", "imb_jain",
+                          "remote_ratio", "straggler_ticks"}
+        assert 0.0 < c["imb_jain"] <= 1.0
+        assert c["efficiency"] > 0
+    # the history line carries the efficiency cells; the regress gate
+    # self-arms on first sight and gates once the trajectory repeats
+    entries = obs_regress.load_history(f"{out}/bench_history.jsonl")
+    assert entries and entries[-1]["scaling_grid"]
+    # gate() excludes `current` from the priors BY IDENTITY, so arm it
+    # with a copied point rather than a duplicated list reference
+    res = obs_regress.gate(entries, current=dict(entries[-1]))
+    assert any(c["name"].startswith("scaling_grid_efficiency[MAAT@")
+               for c in res["checks"])
+    assert res["failures"] == []

@@ -141,6 +141,37 @@ def test_traffic_keys_round_trip_exactly():
                    for k in p0)
 
 
+def test_mesh_keys_round_trip_exactly():
+    """Mesh-observatory runs (Config.mesh, obs/mesh.py) put the
+    traffic-matrix totals and the imbalance keys on the [summary] line;
+    the stats layer passes them through VERBATIM (counts and a
+    dimensionless index, never time-scaled), they round-trip through
+    the parser port exactly, and the default line carries none."""
+    eng, st = run_engine()
+    s = eng.summary(st)
+    # the passthrough is engine-agnostic: inject the documented key set
+    # (tests/test_mesh.py covers the sharded engine producing them)
+    from deneva_tpu.obs.mesh import MESH_SUMMARY_KEYS
+    mesh = {"mesh_tx_total": 6991, "mesh_drop_cnt": 3,
+            "mesh_occ_sum": 3096, "mesh_occ_peak": 245,
+            "straggler_tick_cnt": 25, "imb_jain": 0.9987}
+    assert set(mesh) == set(MESH_SUMMARY_KEYS)
+    d1 = stats_mod.reference_summary({**s, **mesh})
+    d2 = stats_mod.reference_summary({**s, **mesh},
+                                     wall_seconds=s["measured_ticks"]
+                                     * 2.0)
+    for k, v in mesh.items():
+        assert d1[k] == v, k                       # verbatim
+        assert d2[k] == v, k                       # never time-scaled
+    parsed = stats_mod.parse_summary(stats_mod.format_summary(d1))
+    for k, v in mesh.items():
+        assert parsed[k] == pytest.approx(v)
+    # the default (mesh-off) line carries none of them
+    p0 = stats_mod.parse_summary(eng.summary_line(st, wall_seconds=1.0))
+    assert not any(k.startswith(("mesh_", "imb_", "straggler_"))
+                   for k in p0)
+
+
 def test_cc_case_counter_families():
     """The per-algorithm families (reference maat_case1/3 + this build's
     chain counters, occ check aborts) ride the [summary] line VERBATIM
