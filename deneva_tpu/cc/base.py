@@ -73,6 +73,24 @@ KERNEL_CONTRACT: dict = {
     "on_ts_rebase": HookSpec(args=("tick",), returns=("db",)),
 }
 
+#: Whole-program tick obligations, the engine-3 companion to the per-hook
+#: KERNEL_CONTRACT above: the lint tick certifier (deneva_tpu/lint/
+#: certify.py, LINT.md engine 3) traces make_tick/make_sharded_tick over
+#: every registered plugin x workload x opt-in flag (config.py
+#: optin_flags) at this geometry and proves OFFPATH-IMPURE /
+#: CARRY-DRIFT / DONATION-DECLINED / SCATTER-RACE-JAXPR / DTYPE-WIDEN.
+#: ``wide_dtypes`` names the convert_element_type targets the int32
+#: end-to-end design forbids (the 2**31 ts-rebase boundary, packed sort
+#: keys); ``racy_scatters`` the order-dependent scatter primitives that
+#: must declare unique_indices.
+TICK_CERTIFY: dict = {
+    "geometry": {"batch_size": 8, "req_per_query": 4,
+                 "synth_table_size": 64, "query_pool_size": 64,
+                 "node_cnt": 4},
+    "wide_dtypes": ("int64", "uint64", "float64"),
+    "racy_scatters": ("scatter", "scatter-apply"),
+}
+
 
 # --- abort-reason taxonomy (the observatory's machine-readable registry) ---
 #: Every abort event the engine records is tagged with exactly one of

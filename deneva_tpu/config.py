@@ -51,6 +51,22 @@ MODES = (MODE_NORMAL, MODE_NOCC, MODE_QRY_ONLY, MODE_SIMPLE)
 ARRIVAL_MODELS = ("poisson", "mmpp", "step")
 
 
+def _optin(default, on: dict, engines=("tick", "sharded_tick")):
+    """Declare a Config field an OPT-IN FEATURE FLAG with the off-path
+    purity obligation: at its default (off) value the tick jaxpr must be
+    alpha-equivalent to the all-defaults baseline — byte-identical
+    ``[summary]``, zero extra device arrays, zero post-warm recompiles by
+    construction.  ``on`` is the kwarg set that activates the feature at
+    the certifier's trace geometry; ``engines`` names the tick builders
+    the flag applies to.  The registry is machine-read by
+    ``optin_flags()`` and certified per cell by the lint tick certifier
+    (deneva_tpu/lint/certify.py, LINT.md engine 3); a flag field without
+    this metadata (and not excused in NON_OPTIN_KNOBS) fails the
+    auto-discovery guard in tests/test_certify.py."""
+    return dataclasses.field(default=default, metadata={
+        "certify": {"on": dict(on), "engines": tuple(engines)}})
+
+
 @dataclasses.dataclass(frozen=True)
 class Config:
     """One experiment cell: (CC_ALG x WORKLOAD x knobs).
@@ -72,7 +88,7 @@ class Config:
     #: DEBUG_ASSERT/DEBUG_RACE analog (config.h:265-268): run the
     #: invariant-check kernel every tick, counting violations into the
     #: ``invariant_violation_cnt`` stat (engine/debug.py)
-    debug_invariants: bool = False
+    debug_invariants: bool = _optin(False, {"debug_invariants": True})
 
     # --- scheduler / batch engine (replaces MAX_TXN_IN_FLIGHT + worker loop) ---
     batch_size: int = 4096       # concurrent in-flight txns per node (B)
@@ -113,7 +129,8 @@ class Config:
     #: in a carried backlog (``queue_len``); nothing is ever dropped
     #: (arrival_cnt == queue_admit_cnt + queue_len holds exactly), and
     #: the backlog integral becomes the real ``lat_work_queue_time``.
-    arrival: Optional[str] = None
+    arrival: Optional[str] = _optin(
+        None, {"arrival": "poisson", "arrival_rate": 2.0})
     arrival_rate: float = 0.0        # mean arrivals/tick (mmpp: calm rate)
     arrival_burst_rate: float = 0.0  # mmpp burst-regime rate
     arrival_p_burst: float = 0.01    # mmpp calm->burst switch prob per tick
@@ -228,11 +245,11 @@ class Config:
     #: (PARITY.md).  Off, and with no explicit ``compact_lanes``, the
     #: view is the identity and every kernel runs the padded width
     #: bit-identically.
-    compact_auto: bool = False
+    compact_auto: bool = _optin(False, {"compact_auto": True})
     #: static compacted lane count K (explicit opt-in, takes precedence
     #: over ``compact_auto``).  K >= B*R statically disables compaction —
     #: the kernels run the padded view untouched.
-    compact_lanes: Optional[int] = None
+    compact_lanes: Optional[int] = _optin(None, {"compact_lanes": 24})
 
     #: MaaT same-tick commit-chain pair window (cc/maat.py): validators
     #: finishing in the same tick on the same row push each other with
@@ -260,7 +277,7 @@ class Config:
     #: bench_history.jsonl rows comparable.  On CPU the kernel runs in
     #: Pallas interpret mode, so tier-1 and all equivalence tests work
     #: without a TPU.
-    fused_arbitrate: bool = False
+    fused_arbitrate: bool = _optin(False, {"fused_arbitrate": True})
     #: VMEM-capacity guard for the fused kernel: a sort whose
     #: padded-to-pow2 width exceeds this lane count (or whose operand
     #: bytes exceed the hard VMEM budget in ops/fused.py) falls back to
@@ -273,13 +290,14 @@ class Config:
 
     # --- logging / replication (reference config.h:147 LOGGING,
     # :24-27 REPLICA_CNT; system/logger.cpp, worker_thread.cpp:527-554) ---
-    logging: bool = False        # command log gating commit (off by default,
-                                 # like the reference)
+    #: command log gating commit (off by default, like the reference)
+    logging: bool = _optin(False, {"logging": True})
     log_flush_ticks: int = 1     # commit waits this many ticks for the
                                  # LOG_FLUSHED ack (LogThread flush latency)
-    repl_cnt: int = 0            # 0 or 1: replicate the command log to the
-                                 # next shard (LOG_MSG / LOG_MSG_RSP analog;
-                                 # sharded engine only)
+    #: 0 or 1: replicate the command log to the next shard (LOG_MSG /
+    #: LOG_MSG_RSP analog; sharded engine only)
+    repl_cnt: int = _optin(0, {"logging": True, "repl_cnt": 1},
+                           engines=("sharded_tick",))
     #: replication topology (config.h:24-27, ISREPLICA global.h:301):
     #: "aa" — active-active: every shard is a worker and replicates its
     #:   log on its ring successor (the round-3 behavior);
@@ -317,7 +335,8 @@ class Config:
     #: finishing for remote-touching txns (RFWD forwarding), with no 2PC
     #: vote round.  0 = same-tick resolution (the round-1..3 behavior).
     #: Sharded engine only; local accesses always bypass.
-    net_delay_ticks: int = 0
+    net_delay_ticks: int = _optin(0, {"net_delay_ticks": 2},
+                                  engines=("sharded_tick",))
 
     #: per-tick event trace depth (the DEBUG_TIMELINE analog,
     #: config.h:269 + scripts/timeline.py): when > 0, the engine records
@@ -328,7 +347,7 @@ class Config:
     #: The buffer wraps (tick % trace_ticks) and ACCUMULATES, so column
     #: sums always equal whole-run totals; size it >= the run length for
     #: per-tick plots (deneva_tpu/obs/trace.py).
-    trace_ticks: int = 0
+    trace_ticks: int = _optin(0, {"trace_ticks": 8})
 
     #: abort-attribution observatory (cc/base.py ABORT_REASONS +
     #: obs/report.py): when True every abort event is tagged with a
@@ -342,7 +361,7 @@ class Config:
     #: user_abort_cnt.  Off by default — the stats pytree and the
     #: [summary] line stay byte-identical to an engine without the
     #: observatory.
-    abort_attribution: bool = False
+    abort_attribution: bool = _optin(False, {"abort_attribution": True})
 
     #: transaction flight recorder (deneva_tpu/obs/flight.py): when True
     #: the engine carries a per-slot open-span plane (admission tick,
@@ -357,7 +376,7 @@ class Config:
     #: of obs/report.py.  Requires ``abort_attribution`` (restart events
     #: carry reason codes).  Off by default — zero extra device arrays
     #: and a byte-identical [summary] line.
-    flight: bool = False
+    flight: bool = _optin(False, {"flight": True, "abort_attribution": True})
     #: completed-span ring depth (keep-last window; the event ring is
     #: 4x this).  Size it >= expected completions for the exact
     #: full-sampling reconciliation; smaller keeps a p99-biased recent
@@ -372,7 +391,7 @@ class Config:
     #: (``arr_conflict_hist`` / ``arr_conflict_key`` /
     #: ``arr_part_conflict`` / ``arr_wait_depth_hist``; top-K report in
     #: obs/report.py).  Not warmup-gated, like the trace ring.
-    heatmap_bins: int = 0
+    heatmap_bins: int = _optin(0, {"heatmap_bins": 16})
     #: rows of the hot-key report (obs/report.py; host-side only)
     heatmap_topk: int = 8
 
@@ -380,14 +399,14 @@ class Config:
     #: Engine.run / ShardedEngine.run (the PROG_TIMER dump,
     #: system/thread.cpp:86-105; deneva_tpu/obs/prog.py).  Each emission
     #: syncs the device.  0 = off.
-    prog_interval: int = 0
+    prog_interval: int = _optin(0, {"prog_interval": 4})
 
     #: host-side phase profiling (deneva_tpu/obs/profiler.py): time
     #: trace/lower/compile vs dispatch vs execute around every engine
     #: dispatch and count jit recompiles.  Blocks after each dispatch
     #: (forfeits host/device pipelining) but adds zero device work; read
     #: the result from ``engine.profiler.snapshot()``.
-    profile: bool = False
+    profile: bool = _optin(False, {"profile": True})
 
     #: cluster mesh observatory (deneva_tpu/obs/mesh.py): when True the
     #: SHARDED engine carries per-node traffic planes — an (N, T) tx
@@ -404,7 +423,7 @@ class Config:
     #: IMBALANCE watchdog bit (obs/report.py).  Single-shard engines
     #: ignore the flag (no mesh to observe).  Off by default — zero
     #: extra device arrays and a byte-identical [summary] line.
-    mesh: bool = False
+    mesh: bool = _optin(False, {"mesh": True}, engines=("sharded_tick",))
 
     #: compile & memory observatory (deneva_tpu/obs/xmeter.py): per-entry
     #: recompile sentinel (compile counts + trigger signatures; a steady
@@ -417,7 +436,7 @@ class Config:
     #: byte-identical to a build without the observatory.  Adds
     #: ``compile_cnt`` / ``compile_ms`` / ``hbm_bytes`` to [summary];
     #: read the full picture from ``engine.xmeter.snapshot()``.
-    xmeter: bool = False
+    xmeter: bool = _optin(False, {"xmeter": True})
 
     # --- run protocol (reference config.h:349-350: 60s warmup + 60s run) ---
     seed: int = 12345
@@ -525,3 +544,71 @@ class Config:
 
     def replace(self, **kw) -> "Config":
         return dataclasses.replace(self, **kw)
+
+
+@dataclasses.dataclass(frozen=True)
+class OptinFlag:
+    """One certified opt-in flag, as discovered from the ``_optin`` field
+    registry: the field name, its off (default) value, the kwarg set that
+    turns the feature on at the certifier's trace geometry, and which tick
+    builders ("tick" = engine/scheduler.py make_tick, "sharded_tick" =
+    parallel/sharded.py make_sharded_tick) it applies to."""
+
+    name: str
+    default: object
+    on: dict
+    engines: tuple
+
+
+def optin_flags() -> dict:
+    """Machine-readable opt-in flag registry: every Config field declared
+    through ``_optin``, keyed by field name.  The lint tick certifier
+    (deneva_tpu/lint/certify.py) certifies exactly this set; the
+    auto-discovery guard (tests/test_certify.py) asserts every flag-shaped
+    field is either here or excused in NON_OPTIN_KNOBS."""
+    out = {}
+    for f in dataclasses.fields(Config):
+        cert = f.metadata.get("certify")
+        if cert is None:
+            continue
+        default = (f.default if f.default is not dataclasses.MISSING
+                   else f.default_factory())
+        out[f.name] = OptinFlag(name=f.name, default=default,
+                                on=dict(cert["on"]),
+                                engines=tuple(cert["engines"]))
+    return out
+
+
+#: Flag-shaped Config fields (bool default-False / Optional default-None /
+#: int default-0) that are deliberately NOT certified opt-in features, with
+#: the reason.  These change the *semantics* of the tick on purpose — their
+#: off-path is the baseline by definition, not an obligation to prove — or
+#: they are pure host-side run-protocol knobs with no tick jaxpr at all.
+#: The auto-discovery guard fails any flag-shaped field missing from BOTH
+#: this dict and the ``_optin`` registry.
+NON_OPTIN_KNOBS = {
+    "commit_after_access": "semantic variant: reorders commit vs access "
+                           "phases; parity is measured like-for-like "
+                           "against a mirrored oracle, not the baseline",
+    "dense_lock_state": "alternative arbitration kernel with identical "
+                        "decisions; equivalence-tested in tier-1, a "
+                        "different jaxpr by design",
+    "restart_new_ts": "semantic variant of T/O restart timestamping "
+                      "(reference behavior switch, not an observatory)",
+    "key_order": "workload-generation variant (KEY_ORDER): changes the "
+                 "query pool, deliberately changes scheduling",
+    "strict_ppt": "workload-generation variant (STRICT_PPT): changes "
+                  "partition fan-out of generated queries",
+    "ts_twr": "semantic variant: Thomas write rule drops obsolete writes "
+              "(TS_TWR, config.h:123) — decisions legitimately differ",
+    "admit_cap": "client load model (LOAD_RATE vs LOAD_MAX): throttles "
+                 "admission by design; parity runs pin it explicitly",
+    "seq_batch_size": "Calvin epoch size; None->batch_size is a sizing "
+                      "default, not a feature toggle",
+    "warmup_ticks": "stats gating window of the run protocol; the tick "
+                    "graph bakes it as a constant threshold",
+    "txn_read_perc": "workload mix knob (TXN_READ_PERC): changes generated "
+                     "queries, not an engine feature",
+    "tpcc_rbk_perc": "workload mix knob (forced-rollback rate): changes "
+                     "generated queries, not an engine feature",
+}

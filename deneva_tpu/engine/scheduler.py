@@ -1096,3 +1096,15 @@ class Engine:
         d = stats_mod.reference_summary(self.summary(state, wall_seconds),
                                         wall_seconds)
         return stats_mod.format_summary(d, prog=prog)
+
+
+def tick_for_trace(cfg: Config, pool: QueryPool | None = None):
+    """Uncompiled tick callable + a concrete input state for the lint
+    tick certifier (deneva_tpu/lint/certify.py): trace with
+    ``jax.make_jaxpr(fn)(state)``.  Builds a FRESH Engine per call so
+    trace-time caches (e.g. the fused-kernel fallback registry scope)
+    cannot leak between the certifier's on/off traces, and returns the
+    raw ``_tick_fn`` — tracing the jitted wrapper would collapse the
+    whole tick into one opaque pjit equation."""
+    eng = Engine(cfg, pool=pool)
+    return eng._tick_fn, eng.init_state()

@@ -1,5 +1,12 @@
 import sys
 
+if "--certify" in sys.argv:
+    # the certifier's sharded cells need >= 4 virtual devices; the env
+    # must be set before the FIRST jax import (neither deneva_tpu nor
+    # deneva_tpu.lint import jax at module scope, so this is it)
+    from deneva_tpu.lint.certify import _device_env
+    _device_env()
+
 from deneva_tpu.lint.cli import main
 
 sys.exit(main())

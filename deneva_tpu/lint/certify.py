@@ -1,0 +1,466 @@
+"""Engine 3: whole-program tick certifier.
+
+Traces ``make_tick`` / ``make_sharded_tick`` (through the uncompiled
+builders ``engine.scheduler.tick_for_trace`` and
+``parallel.sharded.sharded_tick_for_trace``) via ``jax.make_jaxpr`` at
+small geometry (B=8, R=4, N=4 — cc/base.py TICK_CERTIFY) across the
+config matrix: every registered CC plugin x workloads x every opt-in
+flag auto-discovered from the Config ``_optin`` registry
+(config.optin_flags).  Five obligations, each a typed finding in the
+existing Finding/suppression/exit-code framework:
+
+- **OFFPATH-IMPURE** — for each flag: trace the flag ON, then a FRESH
+  all-defaults build; the off trace must be alpha-equivalent to the
+  cell's baseline after canonicalization (lint/diff_engine.py).  Tracing
+  off AFTER on is deliberate: it catches global trace-state leaks (a
+  scope cache, a module global flipped by the on build) that a plain
+  off-vs-off comparison is blind to.  A flag whose ON trace already
+  equals the baseline is inert for the cell and needs no off trace.
+- **CARRY-DRIFT** — tick output avals == input carry avals (pytree
+  structure, shapes, dtypes), the fixed point that makes run/_run_scan
+  legal and recompile-free; internal scan/while carries are checked too.
+- **DONATION-DECLINED** — every carry leaf named by donate_argnums=0 is
+  actually donated: the single-engine jit lowering must alias every
+  input (``tf.aliasing_output``), the sharded lowering must mark every
+  leaf a donor (``jax.buffer_donor``), and one compiled spot-check per
+  engine kind confirms the executable's ``input_output_alias`` pairs.
+- **SCATTER-RACE-JAXPR** — scatter primitives with an order-dependent
+  combine and unique_indices=False, found by dataflow walk (catches
+  tracer-built indices the AST engine must conservatively skip);
+  anchored to real source lines, so the inline ``# lint:
+  disable=SCATTER-RACE`` grammar applies (the AST rule's suppressions
+  cover this rule at the same site — same invariant).
+- **DTYPE-WIDEN** — ``convert_element_type`` to a 64-bit dtype anywhere
+  in the tick (the int32 end-to-end obligation).
+
+Pure trace-time: no tick ever executes.  Needs >= 4 virtual devices for
+the sharded cells (the CLI entries set
+``--xla_force_host_platform_device_count`` before the first jax import).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+
+from deneva_tpu.lint.rules import Finding
+
+#: trace geometry + dtype/scatter policy (cc/base.py TICK_CERTIFY)
+GEOM_KEYS = ("batch_size", "req_per_query", "synth_table_size",
+             "query_pool_size")
+
+#: workload-local downsizing so TPC-C/PPS cells trace at toy scale
+_WL_KW = {
+    "TPCC": dict(num_wh=2, cust_per_dist=1000, max_items=64,
+                 max_items_per_txn=5, tpcc_max_orders=64,
+                 tpcc_ol_cap=256, tpcc_hist_cap=64),
+    "PPS": dict(max_part_key=64, max_product_key=64,
+                max_supplier_key=64, max_parts_per=4,
+                synth_table_size=8),
+}
+
+#: flag sweeps run on every YCSB cell; on TPC-C/PPS they run for these
+#: representative plugins only (a 2PL and the heaviest validator) —
+#: baseline carry/donation/scatter/dtype checks still cover ALL cells
+_FLAG_SWEEP_ALGS_NON_YCSB = ("NO_WAIT", "MAAT")
+
+
+def _device_env():
+    """Set the virtual-device env BEFORE the first jax import (both CLI
+    entries call this; library users get it from tests/conftest.py)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = \
+            (flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+# ---------------------------------------------------------------------------
+# cell construction
+
+
+def _certify_spec() -> dict:
+    from deneva_tpu.cc.base import TICK_CERTIFY
+    return TICK_CERTIFY
+
+
+def base_cfg(alg: str, workload: str, engine: str):
+    """All-defaults baseline Config for one matrix cell at trace
+    geometry.  Everything not forced here keeps its Config default, so
+    the baseline IS the off path every _optin flag promises to match."""
+    from deneva_tpu.config import Config
+    spec = _certify_spec()["geometry"]
+    kw = {k: spec[k] for k in GEOM_KEYS}
+    kw.update(_WL_KW.get(workload, {}))
+    if engine == "sharded_tick":
+        kw.update(node_cnt=spec["node_cnt"], part_cnt=spec["node_cnt"])
+    return Config(cc_alg=alg, workload=workload, warmup_ticks=0, **kw)
+
+
+def trace_tick(cfg, engine: str):
+    """(closed_jaxpr, out_shape, state) for one FRESH engine build —
+    never reuse a builder across traces, that is the leak the off-after-
+    on ordering exists to catch."""
+    import jax
+    if engine == "tick":
+        from deneva_tpu.engine.scheduler import tick_for_trace
+        fn, state = tick_for_trace(cfg)
+    else:
+        from deneva_tpu.parallel.sharded import sharded_tick_for_trace
+        fn, state = sharded_tick_for_trace(cfg)
+    closed, out_shape = jax.make_jaxpr(fn, return_shape=True)(state)
+    return closed, out_shape, state, fn
+
+
+# ---------------------------------------------------------------------------
+# anchors
+
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# repo root, not package root: fixture jaxprs traced from tests/ must also
+# anchor to a real source line (jax-internal frames live in site-packages,
+# so this filter still rejects them)
+_REPO_ROOT = os.path.dirname(_PKG_ROOT)
+
+
+def _eqn_anchor(eqn) -> tuple[str, int]:
+    """Innermost user frame of an equation inside the repo — a real
+    source line, so the inline suppression grammar applies."""
+    try:
+        frames = list(eqn.source_info.traceback.frames)
+    except Exception:  # noqa: BLE001 — no traceback: anchorless finding
+        return "<jaxpr>", 0
+    best = None
+    for fr in frames:
+        fname = getattr(fr, "file_name", "")
+        if os.path.abspath(fname).startswith(_REPO_ROOT):
+            best = fr
+            break                   # frames are innermost-first
+    if best is None:
+        return "<jaxpr>", 0
+    return best.file_name, int(getattr(best, "line_num", 0) or 0)
+
+
+def _flag_anchor(name: str) -> tuple[str, int]:
+    """The flag's field definition line in config.py."""
+    from deneva_tpu import config as config_mod
+    path = config_mod.__file__
+    with open(path, encoding="utf-8") as fh:
+        for i, ln in enumerate(fh, start=1):
+            if re.match(rf"    {re.escape(name)}\s*:", ln):
+                return path, i
+    return path, 0
+
+
+def _builder_anchor(engine: str) -> tuple[str, int]:
+    import inspect
+    if engine == "tick":
+        from deneva_tpu.engine.scheduler import make_tick as fn
+    else:
+        from deneva_tpu.parallel.sharded import make_sharded_tick as fn
+    return inspect.getsourcefile(fn), inspect.getsourcelines(fn)[1]
+
+
+# ---------------------------------------------------------------------------
+# per-trace checks
+
+
+def _leaf_label(path) -> str:
+    return "".join(str(p) for p in path) or "<root>"
+
+
+def check_carry(cell: str, engine: str, state, out_shape) -> list[Finding]:
+    """Output pytree/avals must equal the input carry exactly."""
+    import jax
+    path, line = _builder_anchor(engine)
+    in_paths, in_tree = jax.tree_util.tree_flatten_with_path(state)
+    out_paths, out_tree = jax.tree_util.tree_flatten_with_path(out_shape)
+    if in_tree != out_tree:
+        return [Finding(
+            rule="CARRY-DRIFT", path=path, line=line,
+            message=f"[{cell}] tick output pytree structure differs from "
+                    f"the input carry ({out_tree} vs {in_tree})")]
+    out = []
+    for (kp, iv), (_, ov) in zip(in_paths, out_paths):
+        ish, idt = tuple(iv.shape), str(iv.dtype)
+        osh, odt = tuple(ov.shape), str(ov.dtype)
+        if (ish, idt) != (osh, odt):
+            out.append(Finding(
+                rule="CARRY-DRIFT", path=path, line=line,
+                message=f"[{cell}] carry leaf {_leaf_label(kp)} drifts: "
+                        f"in {idt}{list(ish)} vs out {odt}{list(osh)}"))
+    return out
+
+
+def walk_tick(cell: str, closed) -> list[Finding]:
+    """SCATTER-RACE-JAXPR + DTYPE-WIDEN + internal CARRY-DRIFT over the
+    whole tick jaxpr (all sub-jaxpr depths)."""
+    from deneva_tpu.lint import jaxpr_engine
+    spec = _certify_spec()
+    racy = frozenset(spec["racy_scatters"])
+    wide = frozenset(spec["wide_dtypes"])
+    out: list[Finding] = []
+    seen: set = set()
+
+    def visit(eqn):
+        nm = eqn.primitive.name
+        if nm in racy and not eqn.params.get("unique_indices", True):
+            path, line = _eqn_anchor(eqn)
+            key = ("SCATTER-RACE-JAXPR", path, line)
+            if key not in seen:
+                seen.add(key)
+                out.append(Finding(
+                    rule="SCATTER-RACE-JAXPR", path=path, line=line,
+                    message=f"[{cell}] `{nm}` with unique_indices=False: "
+                            "order-dependent duplicate-index combine"))
+        elif nm == "convert_element_type" and \
+                str(eqn.params.get("new_dtype")) in wide:
+            path, line = _eqn_anchor(eqn)
+            key = ("DTYPE-WIDEN", path, line)
+            if key not in seen:
+                seen.add(key)
+                out.append(Finding(
+                    rule="DTYPE-WIDEN", path=path, line=line,
+                    message=f"[{cell}] convert_element_type to "
+                            f"{eqn.params['new_dtype']} in the tick"))
+        err = jaxpr_engine._carry_error(eqn)
+        if err:
+            path, line = _eqn_anchor(eqn)
+            key = ("CARRY-DRIFT", path, line, err)
+            if key not in seen:
+                seen.add(key)
+                out.append(Finding(
+                    rule="CARRY-DRIFT", path=path, line=line,
+                    message=f"[{cell}] {err}"))
+
+    jaxpr_engine._walk(closed.jaxpr, closed.consts, visit, lambda _: None)
+    return out
+
+
+def check_donation(cell: str, engine: str, fn, state,
+                   compiled: bool = False) -> list[Finding]:
+    """Every carry leaf must be donated.  Lowering-level markers are the
+    per-cell check (cheap); ``compiled=True`` additionally compiles and
+    counts the executable's input_output_alias pairs (one spot-check per
+    engine kind)."""
+    import jax
+    path, line = _builder_anchor(engine)
+    n_leaves = len(jax.tree_util.tree_leaves(state))
+    out: list[Finding] = []
+    low = jax.jit(fn, donate_argnums=0).lower(state)
+    txt = low.as_text()
+    marker = ("tf.aliasing_output" if engine == "tick"
+              else "jax.buffer_donor")
+    n_marked = txt.count(marker)
+    if n_marked < n_leaves:
+        out.append(Finding(
+            rule="DONATION-DECLINED", path=path, line=line,
+            message=f"[{cell}] lowering marks {n_marked}/{n_leaves} "
+                    f"carry leaves `{marker}` — the rest are copied, "
+                    "not donated"))
+    if compiled and not out:
+        comp = low.compile()
+        n_alias = len(re.findall(r"(?:may|must)-alias", comp.as_text()))
+        if n_alias < n_leaves:
+            out.append(Finding(
+                rule="DONATION-DECLINED", path=path, line=line,
+                message=f"[{cell}] compiled executable aliases "
+                        f"{n_alias}/{n_leaves} carry leaves "
+                        "(input_output_alias)"))
+    return out
+
+
+def check_offpath(cell: str, flag, base_canon: list[str],
+                  cfg_base, engine: str) -> list[Finding]:
+    """Flag off ==> jaxpr alpha-equivalent to the baseline.  The on
+    trace already happened; re-trace the DEFAULT config on a fresh
+    build and diff against the cell baseline."""
+    from deneva_tpu.lint import diff_engine
+    off_closed, _, _, _ = trace_tick(cfg_base, engine)
+    off_canon = diff_engine.canonicalize(off_closed.jaxpr,
+                                         off_closed.consts)
+    msg = diff_engine.diff(base_canon, off_canon,
+                           label_base="baseline",
+                           label_other=f"off-after-{flag.name}")
+    if msg is None:
+        return []
+    path, line = _flag_anchor(flag.name)
+    return [Finding(
+        rule="OFFPATH-IMPURE", path=path, line=line,
+        message=f"[{cell}] default-config trace after a {flag.name}=on "
+                f"build no longer matches the baseline — the on build "
+                f"leaked trace state: {msg}")]
+
+
+# ---------------------------------------------------------------------------
+# the matrix
+
+
+def certify_cell(alg: str, workload: str, engine: str,
+                 flags: dict, sweep_flags: bool,
+                 donation_compiled: bool = False,
+                 log=None) -> list[Finding]:
+    """All obligations for one (plugin, workload, engine) cell."""
+    from deneva_tpu.lint import diff_engine
+    cell = f"{engine}:{alg}/{workload}"
+    cfg_base = base_cfg(alg, workload, engine)
+    findings: list[Finding] = []
+
+    closed, out_shape, state, fn = trace_tick(cfg_base, engine)
+    base_canon = diff_engine.canonicalize(closed.jaxpr, closed.consts)
+    findings += check_carry(cell, engine, state, out_shape)
+    findings += walk_tick(cell, closed)
+    findings += check_donation(cell, engine, fn, state,
+                               compiled=donation_compiled)
+    if log:
+        log(f"{cell}: baseline {len(base_canon)} canonical lines")
+
+    if not sweep_flags:
+        return findings
+    for name in sorted(flags):
+        flag = flags[name]
+        if engine not in flag.engines:
+            continue
+        cfg_on = cfg_base.replace(**flag.on)
+        on_closed, on_shape, on_state, _ = trace_tick(cfg_on, engine)
+        on_cell = f"{cell}+{name}"
+        findings += check_carry(on_cell, engine, on_state, on_shape)
+        findings += walk_tick(on_cell, on_closed)
+        on_canon = diff_engine.canonicalize(on_closed.jaxpr,
+                                            on_closed.consts)
+        if on_canon == base_canon:
+            if log:
+                log(f"{on_cell}: inert (on == baseline), off trace "
+                    "skipped")
+            continue
+        findings += check_offpath(cell, flag, base_canon, cfg_base,
+                                  engine)
+        if log:
+            log(f"{on_cell}: on differs "
+                f"({len(on_canon)} lines), off re-verified")
+    return findings
+
+
+def run_certify(algs=None, workloads=None, engines=None, flags=None,
+                log=None) -> list[Finding]:
+    """The full matrix.  Findings come back deduped by (rule, path,
+    line) with a cell count, suppressions applied from source."""
+    import jax
+    from deneva_tpu import cc
+    from deneva_tpu.config import WORKLOADS, optin_flags
+
+    engines = tuple(engines) if engines else ("tick", "sharded_tick")
+    algs = tuple(algs) if algs else tuple(sorted(cc.REGISTRY))
+    workloads = tuple(workloads) if workloads else tuple(WORKLOADS)
+    all_flags = optin_flags()
+    if flags:
+        all_flags = {k: v for k, v in all_flags.items() if k in set(flags)}
+
+    n_nodes = _certify_spec()["geometry"]["node_cnt"]
+    if "sharded_tick" in engines and len(jax.devices()) < n_nodes:
+        raise RuntimeError(
+            f"certify needs >= {n_nodes} devices for the sharded cells "
+            f"(have {len(jax.devices())}); set XLA_FLAGS="
+            "--xla_force_host_platform_device_count=8 before the first "
+            "jax import, or restrict to --engines tick")
+
+    raw: list[Finding] = []
+    spot_checked: set[str] = set()
+    for engine in engines:
+        for workload in workloads:
+            if engine == "sharded_tick" and workload != "YCSB":
+                # the sharded protocol layers (exchange, 2PC, Calvin
+                # epochs) are workload-independent; YCSB covers them
+                continue
+            for alg in algs:
+                sweep = workload == "YCSB" or \
+                    alg in _FLAG_SWEEP_ALGS_NON_YCSB
+                compiled = engine not in spot_checked
+                spot_checked.add(engine)
+                raw.extend(certify_cell(
+                    alg, workload, engine, all_flags,
+                    sweep_flags=sweep, donation_compiled=compiled,
+                    log=log))
+    return _dedup_and_suppress(raw)
+
+
+def _dedup_and_suppress(raw: list[Finding]) -> list[Finding]:
+    from deneva_tpu.lint import suppress
+    merged: dict[tuple, Finding] = {}
+    counts: dict[tuple, int] = {}
+    for f in raw:
+        key = (f.rule, f.path, f.line)
+        if key in merged:
+            counts[key] += 1
+        else:
+            merged[key] = f
+            counts[key] = 1
+    findings = []
+    for key, f in merged.items():
+        if counts[key] > 1:
+            f.message += f" [x{counts[key]} cells]"
+        findings.append(f)
+
+    by_path: dict[str, list[Finding]] = {}
+    for f in findings:
+        by_path.setdefault(f.path, []).append(f)
+    for path, fs in by_path.items():
+        if not os.path.isfile(path):
+            continue
+        with open(path, encoding="utf-8") as fh:
+            sup = suppress.scan(path, fh.read())
+        for f in fs:
+            hit, reason = sup.match(f)
+            if not hit and f.rule == "SCATTER-RACE-JAXPR":
+                # the AST rule's suppression at the same site carries the
+                # same invariant — honor it for the dataflow twin
+                probe = Finding(rule="SCATTER-RACE", path=f.path,
+                                line=f.line, message="",
+                                end_line=f.end_line)
+                hit, reason = sup.match(probe)
+            if hit:
+                f.suppressed = True
+                f.suppress_reason = reason
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# CLI (standalone: python -m deneva_tpu.lint.certify; also reached via
+# python -m deneva_tpu.lint --certify)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m deneva_tpu.lint.certify",
+        description="whole-program tick certifier (lint engine 3)")
+    ap.add_argument("--algs", help="comma-separated CC algorithms "
+                                   "(default: all registered)")
+    ap.add_argument("--workloads", help="comma-separated workloads")
+    ap.add_argument("--engines",
+                    help="comma-separated tick builders: tick,sharded_tick")
+    ap.add_argument("--flags", help="comma-separated opt-in flag names")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--show-suppressed", action="store_true")
+    ap.add_argument("--quiet", action="store_true",
+                    help="suppress per-cell progress lines")
+    args = ap.parse_args(argv)
+
+    split = lambda s: tuple(x for x in s.split(",") if x) if s else None
+    log = None if args.quiet or args.format == "json" else \
+        (lambda m: print(f"[certify] {m}", file=sys.stderr))
+    findings = run_certify(algs=split(args.algs),
+                           workloads=split(args.workloads),
+                           engines=split(args.engines),
+                           flags=split(args.flags), log=log)
+    from deneva_tpu.lint.cli import render_json, render_text
+    if args.format == "json":
+        print(render_json(findings))
+    else:
+        print(render_text(findings, args.show_suppressed))
+    return min(sum(not f.suppressed for f in findings), 125)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    _device_env()
+    sys.exit(main())

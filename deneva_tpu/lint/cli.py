@@ -3,7 +3,10 @@
 Exit code = number of unsuppressed findings (capped at 125 so it never
 collides with signal exit codes).  Engine 2 (the jaxpr plugin verifier)
 runs by default when a scanned path lies inside the deneva_tpu package;
-force it on/off with --jaxpr/--no-jaxpr.
+force it on/off with --jaxpr/--no-jaxpr.  ``--certify`` runs engine 3
+(the whole-program tick certifier, lint/certify.py) INSTEAD of engines
+1-2 — it traces the full config matrix, so it gets its own stage in
+scripts/check.sh rather than riding every lint invocation.
 """
 
 from __future__ import annotations
@@ -104,7 +107,22 @@ def main(argv: list[str] | None = None) -> int:
                      default=None, help="force the plugin verifier on")
     grp.add_argument("--no-jaxpr", dest="jaxpr", action="store_false",
                      help="AST engine only")
+    ap.add_argument("--certify", action="store_true",
+                    help="run engine 3 only: the whole-program tick "
+                         "certifier over the full config matrix "
+                         "(see python -m deneva_tpu.lint.certify for "
+                         "cell filters)")
     args = ap.parse_args(argv)
+
+    if args.certify:
+        from deneva_tpu.lint import certify
+        findings = certify.run_certify(
+            log=lambda m: print(f"[certify] {m}", file=sys.stderr))
+        if args.format == "json":
+            print(render_json(findings))
+        else:
+            print(render_text(findings, args.show_suppressed))
+        return min(sum(not f.suppressed for f in findings), 125)
 
     paths = args.paths or [os.path.dirname(os.path.dirname(
         os.path.abspath(__file__)))]

@@ -1,0 +1,279 @@
+"""Jaxpr canonicalizer + differ for the tick certifier (engine 3).
+
+``jax.make_jaxpr`` output is not directly comparable: tracing the same
+computation twice (or with an inert flag toggled) may permute independent
+equations, rename every variable, and drag along dead constvars.  The
+certifier's OFFPATH-IMPURE obligation is *alpha-equivalence modulo those
+artifacts* — so this module rewrites a (jaxpr, consts) pair into a
+canonical text form that is invariant under:
+
+- **variable renaming** — variables get content-addressed tokens: inputs
+  are positional (``in0``…), constants hash their *content*, and each
+  equation output is named by the hash of (primitive, canonical params,
+  input tokens, output avals) — pure structurally-identical equations
+  therefore unify (CSE), and the name of a value never depends on trace
+  order;
+- **reordering of independent equations** — scheduling is a deterministic
+  topological sort: among ready equations the smallest content hash goes
+  first (effectful equations keep their relative program order via an
+  explicit chain);
+- **dead code / dead constants** — a backward liveness pass drops
+  equations whose outputs are unused (unless effectful) and constvars
+  nothing live reads.
+
+Sub-jaxprs in equation params (scan/while/cond/pjit bodies) canonicalize
+recursively, so a reorder inside a loop body is normalized too.  Equal
+canonical forms imply the two traces compute the same function the same
+way — which is what makes "flag off ⇒ byte-identical [summary], zero
+extra arrays, zero recompiles" a theorem instead of a runtime test.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+
+import jax
+import numpy as np
+
+_ADDR_RE = re.compile(r" at 0x[0-9a-fA-F]+")
+_HASH_W = 16        # hex chars kept per content hash (64 bits)
+
+
+def _sha(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def _aval_str(v) -> str:
+    aval = getattr(v, "aval", v)
+    short = getattr(aval, "str_short", None)
+    return short() if short is not None else _ADDR_RE.sub("", repr(aval))
+
+
+def _is_literal(v) -> bool:
+    return hasattr(v, "val") and not hasattr(v, "count")
+
+
+def _is_dropvar(v) -> bool:
+    return type(v).__name__ == "DropVar"
+
+
+def _const_token(c) -> str:
+    try:
+        arr = np.asarray(c)
+        return (f"c:{arr.dtype}{list(arr.shape)}:"
+                f"{_sha(arr.tobytes())[:_HASH_W]}")
+    except Exception:  # noqa: BLE001 — non-array const: fall back to repr
+        return f"c:{_sha(_ADDR_RE.sub('', repr(c)).encode())[:_HASH_W]}"
+
+
+def _param_token(v, memo: dict) -> str:
+    """Stable, content-addressed token for one equation param value."""
+    if v is None or isinstance(v, (bool, int, float, complex, str, bytes)):
+        return repr(v)
+    if isinstance(v, np.dtype) or type(v).__module__ == "numpy":
+        if isinstance(v, np.ndarray):
+            return _const_token(v)
+        return repr(v)                      # numpy scalar / dtype
+    if isinstance(v, jax.Array):
+        return _const_token(v)
+    if hasattr(v, "jaxpr") and hasattr(v, "consts"):    # ClosedJaxpr
+        return f"jaxpr:{fingerprint(v.jaxpr, v.consts, memo)}"
+    if hasattr(v, "eqns"):                              # raw Jaxpr
+        return f"jaxpr:{fingerprint(v, (), memo)}"
+    if isinstance(v, (tuple, list)):
+        inner = ",".join(_param_token(x, memo) for x in v)
+        return f"({inner})"
+    if isinstance(v, dict):
+        inner = ",".join(f"{k}={_param_token(v[k], memo)}"
+                         for k in sorted(v, key=str))
+        return f"{{{inner}}}"
+    if callable(v):
+        name = getattr(v, "__name__", None)
+        return f"fn:{name}" if name else \
+            f"fn:{_ADDR_RE.sub('', repr(v))}"
+    return _ADDR_RE.sub("", repr(v))
+
+
+def _params_str(eqn, memo: dict) -> str:
+    return ",".join(f"{k}={_param_token(eqn.params[k], memo)}"
+                    for k in sorted(eqn.params))
+
+
+def canonicalize(jaxpr, consts=(), memo: dict | None = None) -> list[str]:
+    """Canonical text form of a (jaxpr, consts) pair: a list of lines
+    (header, live consts, equations in canonical order, outputs) equal
+    for alpha-equivalent traces.  ``memo`` caches sub-jaxpr fingerprints
+    by object id across one certifier run."""
+    if memo is None:
+        memo = {}
+
+    # ---- backward liveness: drop dead eqns and dead constvars ----
+    live: set = set()
+    for v in jaxpr.outvars:
+        if not _is_literal(v):
+            live.add(v)
+    keep = [False] * len(jaxpr.eqns)
+    for i in range(len(jaxpr.eqns) - 1, -1, -1):
+        eqn = jaxpr.eqns[i]
+        if getattr(eqn, "effects", None) or \
+                any(v in live for v in eqn.outvars):
+            keep[i] = True
+            for v in eqn.invars:
+                if not _is_literal(v):
+                    live.add(v)
+    eqns = [e for e, k in zip(jaxpr.eqns, keep) if k]
+
+    # ---- seed tokens: positional invars, content-addressed consts ----
+    token: dict = {}
+    for i, v in enumerate(jaxpr.invars):
+        token[v] = f"in{i}"
+    const_lines = []
+    consts = tuple(consts)
+    for i, v in enumerate(jaxpr.constvars):
+        if v not in live and all(v not in e.invars for e in eqns):
+            continue                        # dead const: not part of the form
+        tok = (_const_token(consts[i]) if i < len(consts)
+               else f"cv:{_aval_str(v)}")   # raw jaxpr: aval-typed constvar
+        token[v] = tok
+        const_lines.append(f"{tok} {_aval_str(v)}")
+
+    def in_tok(v) -> str:
+        if _is_literal(v):
+            val = v.val
+            try:
+                body = _sha(np.asarray(val).tobytes())[:_HASH_W] \
+                    if getattr(val, "ndim", 1) else repr(val)
+            except Exception:  # noqa: BLE001
+                body = repr(val)
+            return f"lit:{_aval_str(v)}:{body}"
+        return token[v]
+
+    # ---- dependency graph over kept eqns ----
+    producer: dict = {}
+    for i, eqn in enumerate(eqns):
+        for v in eqn.outvars:
+            if not _is_dropvar(v):
+                producer[v] = i
+    ndeps = [0] * len(eqns)
+    users: list[list[int]] = [[] for _ in eqns]
+    prev_effect = None
+    for i, eqn in enumerate(eqns):
+        deps = {producer[v] for v in eqn.invars
+                if not _is_literal(v) and v in producer}
+        if getattr(eqn, "effects", None):
+            if prev_effect is not None:
+                deps.add(prev_effect)       # effects keep program order
+            prev_effect = i
+        ndeps[i] = len(deps)
+        for d in deps:
+            users[d].append(i)
+
+    # ---- deterministic ready-set schedule + CSE ----
+    import heapq
+    heap: list = []
+    seq = 0                                 # tie-break among equal hashes
+
+    def fp_of(i: int) -> str:
+        eqn = eqns[i]
+        body = (f"{eqn.primitive.name}[{_params_str(eqn, memo)}]"
+                f"({','.join(in_tok(v) for v in eqn.invars)})"
+                f"->({','.join(_aval_str(v) for v in eqn.outvars)})")
+        if getattr(eqn, "effects", None):
+            body += f"!{sorted(map(str, eqn.effects))}"
+        return _sha(body.encode())[:_HASH_W]
+
+    for i in range(len(eqns)):
+        if ndeps[i] == 0:
+            heapq.heappush(heap, (fp_of(i), seq, i))
+            seq += 1
+
+    lines: list[str] = []
+    emitted: dict[str, int] = {}            # pure-eqn CSE: fp -> 1
+    while heap:
+        fp, _s, i = heapq.heappop(heap)
+        eqn = eqns[i]
+        effectful = bool(getattr(eqn, "effects", None))
+        dup = fp in emitted and not effectful
+        if effectful and fp in emitted:
+            n = emitted[fp]
+            emitted[fp] = n + 1
+            fp = f"{fp}#{n}"                # distinct effect instances
+        elif not dup:
+            emitted[fp] = 1
+        outs = []
+        for j, v in enumerate(eqn.outvars):
+            t = "_" if _is_dropvar(v) else f"{fp}.{j}"
+            if not _is_dropvar(v):
+                token[v] = t
+            outs.append(t)
+        if not dup:
+            lines.append(
+                f"{' '.join(outs)} = {eqn.primitive.name}"
+                f"[{_params_str(eqn, memo)}] "
+                f"{' '.join(in_tok(v) for v in eqn.invars)}")
+        for u in users[i]:
+            ndeps[u] -= 1
+            if ndeps[u] == 0:
+                heapq.heappush(heap, (fp_of(u), seq, u))
+                seq += 1
+
+    head = [f"in: {','.join(_aval_str(v) for v in jaxpr.invars)}"]
+    head.extend(sorted(const_lines))
+    tail = [f"out: {','.join(in_tok(v) for v in jaxpr.outvars)}"]
+    return head + lines + tail
+
+
+def fingerprint(jaxpr, consts=(), memo: dict | None = None) -> str:
+    """Canonical-form hash; id-memoized for repeated sub-jaxprs."""
+    if memo is None:
+        memo = {}
+    key = id(jaxpr)
+    hit = memo.get(key)
+    if hit is not None:
+        return hit
+    fp = _sha("\n".join(canonicalize(jaxpr, consts, memo)).encode())[:32]
+    memo[key] = fp
+    return fp
+
+
+def diff(base: list[str], other: list[str],
+         label_base: str = "baseline", label_other: str = "other",
+         limit: int = 3) -> str | None:
+    """None if the canonical forms match; else a compact human message:
+    equation-count delta, primitive-histogram delta, and up to ``limit``
+    example lines unique to each side."""
+    if base == other:
+        return None
+
+    def prims(lines):
+        h: dict[str, int] = {}
+        for ln in lines:
+            m = re.search(r" = (\w+)\[", ln)
+            if m:
+                h[m.group(1)] = h.get(m.group(1), 0) + 1
+        return h
+
+    hb, ho = prims(base), prims(other)
+    delta = {p: ho.get(p, 0) - hb.get(p, 0)
+             for p in sorted(set(hb) | set(ho))
+             if ho.get(p, 0) != hb.get(p, 0)}
+    only_b = [ln for ln in base if ln not in set(other)]
+    only_o = [ln for ln in other if ln not in set(base)]
+
+    def clip(ln):
+        return ln if len(ln) <= 140 else ln[:137] + "..."
+
+    parts = [f"{len(base)} vs {len(other)} canonical lines"]
+    if delta:
+        parts.append("prim delta " + ", ".join(
+            f"{p}{n:+d}" for p, n in list(delta.items())[:6]))
+    if only_b:
+        parts.append(f"only in {label_base}: " + " | ".join(
+            clip(ln) for ln in only_b[:limit]))
+    if only_o:
+        parts.append(f"only in {label_other}: " + " | ".join(
+            clip(ln) for ln in only_o[:limit]))
+    if not only_b and not only_o:
+        parts.append("same line multiset, different order/multiplicity")
+    return "; ".join(parts)

@@ -178,6 +178,73 @@ _ALL = [
             "body application.",
     ),
     Rule(
+        id="OFFPATH-IMPURE",
+        title="Opt-in flag leaks into the off-path tick jaxpr",
+        rationale="Every flag in the Config _optin registry promises: at "
+                  "its default (off) value the tick jaxpr is "
+                  "alpha-equivalent to the all-defaults baseline.  A "
+                  "diff means the off path carries extra arrays, does "
+                  "extra work, or a previous flag-on build leaked trace "
+                  "state (a scope cache, a module global) into later "
+                  "builds — breaking the byte-identical [summary] / "
+                  "zero-recompile guarantees every feature PR relies on.",
+        fix="Gate the feature's arrays and equations on the STATIC config "
+            "value (plain Python if at trace time, not lax.cond), and "
+            "keep trace-time caches keyed per build, never module-global.",
+    ),
+    Rule(
+        id="CARRY-DRIFT",
+        title="Tick output avals differ from input avals",
+        rationale="run/_run_scan feed the tick its own output; a drifting "
+                  "carry (shape, dtype, or pytree structure) recompiles "
+                  "every tick, breaks donation, and would make "
+                  "lax.fori_loop reject the body outright.",
+        fix="Return the state with exactly the input shapes/dtypes/"
+            "structure; widen or resize arrays at init, not mid-tick.",
+    ),
+    Rule(
+        id="DONATION-DECLINED",
+        title="donate_argnums buffer not donated by the compiled tick",
+        rationale="The HBM ledger sizes the carry assuming in-place "
+                  "donation; a declined donation silently doubles the "
+                  "resident footprint (input + output buffers both "
+                  "live) and invalidates fit_batch sizing.",
+        fix="Keep carry leaves used exactly once in a donatable position "
+            "(no aliasing the same leaf into two outputs, no dtype/"
+            "shape change on the donated path); check the compiled "
+            "artifact's input_output_alias for what XLA kept.",
+    ),
+    Rule(
+        id="SCATTER-RACE-JAXPR",
+        title="Non-commutative scatter with unique_indices=False in the "
+              "tick jaxpr",
+        rationale="The dataflow-level twin of SCATTER-RACE: a scatter "
+                  "primitive whose combine is order-dependent (set/mul "
+                  "on overlapping lanes) and whose indices are not "
+                  "declared unique applies duplicate updates in "
+                  "unspecified order — the batched-CC data race, now "
+                  "caught even when the indices were built by tracer "
+                  "arithmetic the AST engine cannot see.",
+        fix="Same as SCATTER-RACE: declare unique_indices=True (with "
+            "distinct out-of-bounds lanes for dead entries), use a "
+            "commutative combine, or mask to one winner per index and "
+            "suppress with the invariant.  An inline SCATTER-RACE "
+            "suppression covers this rule at the same site.",
+    ),
+    Rule(
+        id="DTYPE-WIDEN",
+        title="64-bit convert_element_type in the tick jaxpr",
+        rationale="The engine is int32 end to end: the 2**31 ts-rebase "
+                  "boundary, packed sort keys, and TPU-native lane "
+                  "widths all assume it.  A convert_element_type to "
+                  "int64/float64 means an x64-contaminated input or an "
+                  "accidental numpy promotion — doubling bytes on the "
+                  "hot path and shifting overflow behavior.",
+        fix="Pin the producing op's dtype (jnp.int32/float32); if a "
+            "64-bit intermediate is genuinely required, isolate and "
+            "suppress it with the overflow argument spelled out.",
+    ),
+    Rule(
         id="CONTRACT-CONST",
         title="Large concrete array baked into a hook closure",
         rationale="A hook closing over a big device array turns it into "

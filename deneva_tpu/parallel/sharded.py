@@ -1273,9 +1273,12 @@ class ShardedEngine:
             self._spmd_tick, mesh=self.mesh,
             in_specs=(spec, spec, spec), out_specs=spec)
         self._node_idx = node_idx
-        self._jit_tick = jax.jit(
-            lambda st: f(st, self.pool_stacked, self._node_idx),
-            donate_argnums=0)
+        # the unjitted shard_map callable, kept for the lint certifier:
+        # make_jaxpr of the jitted wrapper yields a single opaque pjit
+        # eqn, while this traces the full per-node tick body
+        self._tick_raw = lambda st: f(st, self.pool_stacked,
+                                      self._node_idx)
+        self._jit_tick = jax.jit(self._tick_raw, donate_argnums=0)
         if self.xmeter is not None:
             self._jit_tick = self.xmeter.wrap("sharded_tick",
                                               self._jit_tick)
@@ -1456,3 +1459,14 @@ class ShardedEngine:
 
     def global_data_sum(self, state: ShardState) -> int:
         return int(np.asarray(state.data).sum())
+
+
+def sharded_tick_for_trace(cfg: Config, pool=None, devices=None):
+    """Uncompiled sharded tick callable + a concrete input state for the
+    lint tick certifier (deneva_tpu/lint/certify.py): the unjitted
+    shard_map closure over the stacked pool and node index, traced with
+    ``jax.make_jaxpr(fn)(state)``.  Builds a FRESH ShardedEngine per call
+    so trace-time caches cannot leak between the certifier's traces."""
+    eng = ShardedEngine(cfg, pool=pool, devices=devices)
+    eng._build()
+    return eng._tick_raw, eng.init_state()

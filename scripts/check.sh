@@ -214,6 +214,20 @@ if [ "$regress_rc" -ne 0 ]; then
     exit "$regress_rc"
 fi
 
+echo "== tick certifier (lint engine 3) =="
+# whole-program differential jaxpr certification over the full config
+# matrix: off-path purity, carry fixed points, donation, racy scatters,
+# dtype widening.  Exit code = number of unsuppressed findings.  The
+# sharded cells need >= 4 virtual devices, hence the XLA flag.
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+    python -m deneva_tpu.lint --certify
+certify_rc=$?
+if [ "$certify_rc" -ne 0 ]; then
+    echo "tick certifier FAILED (rc=$certify_rc unsuppressed findings)"
+    exit "$certify_rc"
+fi
+
 echo "== tier-1 pytest =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

@@ -1,0 +1,357 @@
+"""Tick-certifier tests (lint engine 3, deneva_tpu/lint/certify.py).
+
+Three layers: the jaxpr canonicalizer's invariances (alpha-equivalence
+under variable renaming and reordering of independent equations, dead
+code/const elimination), deliberately-broken tick fixtures each rejected
+with its named rule (OFFPATH-IMPURE / CARRY-DRIFT / DONATION-DECLINED /
+SCATTER-RACE-JAXPR / DTYPE-WIDEN), and the matrix itself: a small cell
+in tier-1, the clean full matrix under `-m slow` (the same run
+scripts/check.sh gates on), and the auto-discovery guard that fails
+loudly when a future flag-shaped Config field ships without
+certification coverage.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deneva_tpu import config as config_mod
+from deneva_tpu.config import NON_OPTIN_KNOBS, Config, optin_flags
+from deneva_tpu.lint import certify, diff_engine
+
+pytestmark = pytest.mark.lint
+
+
+def canon(fn, *args):
+    closed = jax.make_jaxpr(fn)(*args)
+    return diff_engine.canonicalize(closed.jaxpr, closed.consts)
+
+
+# ---------------------------------------------------------------------------
+# canonicalizer unit suite
+
+
+def test_canon_var_renaming_invariance():
+    # two separate traces bind fresh Var objects throughout — the
+    # canonical forms must still be identical, and so their fingerprints
+    def f(x, y):
+        return x * 2 + y
+
+    c1 = canon(f, jnp.float32(1), jnp.float32(2))
+    c2 = canon(f, jnp.float32(1), jnp.float32(2))
+    assert c1 == c2
+    assert diff_engine.diff(c1, c2) is None
+
+
+def test_canon_reorder_independent_eqns():
+    def ab(x, y):
+        a = x * 2
+        b = y + 3
+        return a + b
+
+    def ba(x, y):
+        b = y + 3
+        a = x * 2
+        return a + b
+
+    one, two = jnp.float32(1), jnp.float32(2)
+    assert canon(ab, one, two) == canon(ba, one, two)
+
+
+def test_canon_dead_code_and_consts_dropped():
+    import numpy as np
+    big = jnp.asarray(np.arange(64, dtype=np.int32))
+
+    def clean(x):
+        return x + 1
+
+    def with_dead(x):
+        dead = (big * 2).sum()          # traced but unused
+        del dead
+        return x + 1
+
+    x = jnp.zeros((8,), jnp.int32)
+    assert canon(clean, x) == canon(with_dead, x)
+
+
+def test_canon_detects_real_difference():
+    def f(x):
+        return x + 1
+
+    def g(x):
+        return x * 2
+
+    x = jnp.zeros((8,), jnp.int32)
+    cf, cg = canon(f, x), canon(g, x)
+    assert cf != cg
+    msg = diff_engine.diff(cf, cg, "base", "other")
+    assert msg is not None and "add" in msg and "mul" in msg
+
+
+def test_canon_sub_jaxpr_reorder_normalized():
+    # a reorder INSIDE a scan body must also canonicalize away: the body
+    # jaxpr rides in eqn params and is fingerprinted recursively
+    def body_ab(c, x):
+        a = c * 2
+        b = x + 3
+        return a + b, x
+
+    def body_ba(c, x):
+        b = x + 3
+        a = c * 2
+        return a + b, x
+
+    xs = jnp.zeros((4,), jnp.float32)
+
+    def scan_with(body):
+        return lambda c: jax.lax.scan(body, c, xs)
+
+    c0 = jnp.float32(0)
+    assert canon(scan_with(body_ab), c0) == canon(scan_with(body_ba), c0)
+
+
+def test_fingerprint_matches_canonical_equality():
+    def f(x):
+        return x - 1
+
+    x = jnp.zeros((4,), jnp.int32)
+    j1, j2 = jax.make_jaxpr(f)(x), jax.make_jaxpr(f)(x)
+    assert diff_engine.fingerprint(j1.jaxpr, j1.consts) == \
+        diff_engine.fingerprint(j2.jaxpr, j2.consts)
+
+
+# ---------------------------------------------------------------------------
+# broken fixtures: each rejected with the named rule
+
+STATE = {"x": jnp.zeros((8,), jnp.int32), "y": jnp.zeros((8,), jnp.int32)}
+
+
+def _fake_trace(fn, state=STATE):
+    closed, out_shape = jax.make_jaxpr(fn, return_shape=True)(state)
+    return closed, out_shape, state, fn
+
+
+def test_fixture_offpath_leak(monkeypatch):
+    """A flag whose ON build leaks trace state: the off-after-on re-trace
+    no longer matches the baseline -> OFFPATH-IMPURE, anchored at the
+    flag's config.py field line."""
+    def clean(s):
+        return {"x": s["x"] + 1, "y": s["y"]}
+
+    def leaked(s):
+        # the leak: an extra array the off path was promised not to carry
+        return {"x": s["x"] + 1 + jnp.arange(8, dtype=jnp.int32),
+                "y": s["y"]}
+
+    base_closed = jax.make_jaxpr(clean)(STATE)
+    base_canon = diff_engine.canonicalize(base_closed.jaxpr,
+                                          base_closed.consts)
+    monkeypatch.setattr(certify, "trace_tick",
+                        lambda cfg, engine: _fake_trace(leaked))
+    flag = optin_flags()["abort_attribution"]
+    found = certify.check_offpath("tick:FIXTURE", flag, base_canon,
+                                  None, "tick")
+    assert [f.rule for f in found] == ["OFFPATH-IMPURE"]
+    assert found[0].path == config_mod.__file__
+    assert found[0].line > 0
+    assert "abort_attribution" in found[0].message
+
+
+def test_fixture_offpath_clean(monkeypatch):
+    def clean(s):
+        return {"x": s["x"] + 1, "y": s["y"]}
+
+    base_closed = jax.make_jaxpr(clean)(STATE)
+    base_canon = diff_engine.canonicalize(base_closed.jaxpr,
+                                          base_closed.consts)
+    monkeypatch.setattr(certify, "trace_tick",
+                        lambda cfg, engine: _fake_trace(clean))
+    flag = optin_flags()["abort_attribution"]
+    assert certify.check_offpath("tick:FIXTURE", flag, base_canon,
+                                 None, "tick") == []
+
+
+def test_fixture_carry_drift():
+    """A dummy tick whose output widens a carry leaf dtype -> CARRY-DRIFT
+    naming the leaf."""
+    def drifting(s):
+        return {"x": s["x"].astype(jnp.float32), "y": s["y"]}
+
+    _, out_shape, state, _ = _fake_trace(drifting)
+    found = certify.check_carry("tick:FIXTURE", "tick", state, out_shape)
+    assert [f.rule for f in found] == ["CARRY-DRIFT"]
+    assert "'x'" in found[0].message and "float32" in found[0].message
+
+
+def test_fixture_carry_structure_drift():
+    def restructure(s):
+        return {"x": s["x"], "y": s["y"], "z": s["x"] + 1}
+
+    _, out_shape, state, _ = _fake_trace(restructure)
+    found = certify.check_carry("tick:FIXTURE", "tick", state, out_shape)
+    assert [f.rule for f in found] == ["CARRY-DRIFT"]
+    assert "structure" in found[0].message
+
+
+def test_fixture_donation_declined():
+    """An entry point that replaces a carry leaf with a fresh constant:
+    XLA cannot alias the donated input into that output, so the lowering
+    marks fewer leaves than the carry has -> DONATION-DECLINED."""
+    def const_out(s):
+        return {"x": jnp.zeros((8,), jnp.int32), "y": s["y"] + 1}
+
+    _, _, state, fn = _fake_trace(const_out)
+    found = certify.check_donation("tick:FIXTURE", "tick", fn, state)
+    assert [f.rule for f in found] == ["DONATION-DECLINED"]
+    assert "1/2" in found[0].message
+
+
+def test_fixture_donation_clean():
+    def good(s):
+        return {"x": s["x"] + 1, "y": s["y"] + 1}
+
+    _, _, state, fn = _fake_trace(good)
+    assert certify.check_donation("tick:FIXTURE", "tick", fn, state) == []
+
+
+def test_fixture_scatter_race_jaxpr():
+    """Duplicate-capable tracer-built indices with a non-commutative
+    `.set` scatter -> SCATTER-RACE-JAXPR.  The indices come from tracer
+    arithmetic, exactly the case the AST engine must skip."""
+    def racy(s):
+        idx = s["y"] % 4                      # duplicates possible
+        return {"x": s["x"].at[idx].set(1), "y": s["y"]}
+
+    closed, _, _, _ = _fake_trace(racy)
+    found = certify.walk_tick("tick:FIXTURE", closed)
+    assert "SCATTER-RACE-JAXPR" in [f.rule for f in found]
+    f = next(f for f in found if f.rule == "SCATTER-RACE-JAXPR")
+    assert f.path.endswith("test_certify.py") and f.line > 0
+
+
+def test_fixture_scatter_commutative_clean():
+    def additive(s):
+        idx = s["y"] % 4
+        return {"x": s["x"].at[idx].add(1), "y": s["y"]}
+
+    closed, _, _, _ = _fake_trace(additive)
+    assert certify.walk_tick("tick:FIXTURE", closed) == []
+
+
+def test_fixture_dtype_widen():
+    """An int64 widening (traced under x64 so jax does not silently
+    truncate it back) -> DTYPE-WIDEN."""
+    with jax.experimental.enable_x64():
+        def widening(s):
+            return {"x": (s["x"].astype(jnp.int64)
+                          + jnp.int64(1)).astype(jnp.int32),
+                    "y": s["y"]}
+
+        state = {"x": jnp.zeros((8,), jnp.int32),
+                 "y": jnp.zeros((8,), jnp.int32)}
+        closed, _, _, _ = _fake_trace(widening, state)
+    found = certify.walk_tick("tick:FIXTURE", closed)
+    assert "DTYPE-WIDEN" in [f.rule for f in found]
+    f = next(f for f in found if f.rule == "DTYPE-WIDEN")
+    assert "int64" in f.message
+
+
+# ---------------------------------------------------------------------------
+# auto-discovery guard: certified flags == flag-shaped Config fields
+
+
+def _flag_shaped_fields():
+    """Heuristic surface a future feature flag will land on: bool
+    defaulting False, Optional defaulting None, or int defaulting 0."""
+    out = []
+    for f in dataclasses.fields(Config):
+        if f.default is dataclasses.MISSING:
+            default = (f.default_factory()
+                       if f.default_factory is not dataclasses.MISSING
+                       else None)
+        else:
+            default = f.default
+        ty = str(f.type)
+        if (default is False and "bool" in ty) \
+                or (default is None and "Optional" in ty) \
+                or (default == 0 and default is not False
+                    and "int" in ty):
+            out.append(f.name)
+    return out
+
+
+def test_autodiscovery_guard_every_flag_covered():
+    """Every flag-shaped field must be certified (_optin) or excused in
+    NON_OPTIN_KNOBS with a reason — a new flag without coverage fails
+    here, loudly, before it ships uncertified."""
+    flags = optin_flags()
+    uncovered = [n for n in _flag_shaped_fields()
+                 if n not in flags and n not in NON_OPTIN_KNOBS]
+    assert uncovered == [], (
+        f"Config fields {uncovered} look like opt-in feature flags but "
+        "are neither declared with _optin(...) (certified by the lint "
+        "tick certifier) nor excused in NON_OPTIN_KNOBS with a reason — "
+        "add one or the other (config.py)")
+    # no stale excuses, and every excuse carries a reason
+    assert all(NON_OPTIN_KNOBS.values()), "bare NON_OPTIN_KNOBS excuse"
+    overlap = set(flags) & set(NON_OPTIN_KNOBS)
+    assert overlap == set(), f"{overlap} both certified and excused"
+
+
+def test_optin_registry_on_kwargs_construct():
+    """Every flag's on-kwargs must yield a valid Config on its declared
+    engines' baseline cells (otherwise the matrix would silently skip)."""
+    for name, flag in optin_flags().items():
+        engine = flag.engines[0]
+        base = certify.base_cfg("NO_WAIT", "YCSB", engine)
+        on = base.replace(**flag.on)
+        assert getattr(on, name) != flag.default, name
+        assert flag.engines and all(
+            e in ("tick", "sharded_tick") for e in flag.engines), name
+
+
+# ---------------------------------------------------------------------------
+# the matrix
+
+
+def test_certify_small_cell_clean():
+    """One single-engine cell with a non-inert flag sweep: the tier-1
+    anchor that the certifier passes end to end on real ticks."""
+    found = certify.run_certify(
+        algs=("NO_WAIT",), workloads=("YCSB",), engines=("tick",),
+        flags=("abort_attribution", "trace_ticks", "xmeter"))
+    assert [f for f in found if not f.suppressed] == []
+
+
+def test_certify_sharded_cell_clean():
+    found = certify.run_certify(
+        algs=("WAIT_DIE",), workloads=("YCSB",),
+        engines=("sharded_tick",), flags=("mesh",))
+    assert [f for f in found if not f.suppressed] == []
+
+
+@pytest.mark.slow
+def test_certify_full_matrix_clean():
+    """The acceptance criterion: 0 unsuppressed findings over the full
+    matrix (same run scripts/check.sh gates on)."""
+    found = certify.run_certify()
+    assert [f for f in found if not f.suppressed] == [], \
+        [f"{f.rule} {f.location()}: {f.message}" for f in found
+         if not f.suppressed]
+
+
+def test_certify_cli_exit_code_and_json(tmp_path):
+    import json
+    import subprocess
+    import sys
+    out = subprocess.run(
+        [sys.executable, "-m", "deneva_tpu.lint.certify",
+         "--algs", "NO_WAIT", "--workloads", "YCSB",
+         "--engines", "tick", "--flags", "profile",
+         "--format", "json"],
+        capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr
+    doc = json.loads(out.stdout)
+    assert doc["unsuppressed"] == 0

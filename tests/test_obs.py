@@ -119,15 +119,12 @@ def test_chrome_trace_schema(tmp_path):
 
 
 # ---- disabled path --------------------------------------------------------
-
-def test_disabled_path_bit_identical_and_lean():
-    eng_obs, st_obs = run(trace_ticks=64, prog_interval=0)
-    eng_off, st_off = run(trace_ticks=0)
-    assert "arr_trace" not in st_off.stats
-    assert "arr_lat_start" not in st_off.stats
-    assert eng_off.profiler is None
-    # tracing must not perturb the simulation: summaries bit-identical
-    assert eng_off.summary(st_off) == eng_obs.summary(st_obs)
+# (The trace_ticks=0 bit-identity cell that used to live here is now
+# proven statically by the tick certifier's OFFPATH-IMPURE rule —
+# trace_ticks is a registered opt-in flag, so every plugin x workload
+# cell checks that the off-trace jaxpr is alpha-equivalent to baseline;
+# see deneva_tpu/lint/certify.py and LINT.md engine 3.  The runtime
+# off-path sentinel for engine 1 lives in test_flight.py.)
 
 
 # ---- profiler + run record ------------------------------------------------
