@@ -39,6 +39,16 @@ KNEE (the highest rate still served with served/offered >= 0.95) and
 recording p50/p99 latency, queue depth and the OVERLOAD watchdog bit per
 point into ``offered_load_sweep.json``.  EXPERIMENTS.md has the recipe.
 
+With ``--faults`` the script runs the fault-plane smoke (Config.faults,
+deneva_tpu/faults/): three scenarios on a small 2-node sharded CALVIN
+cell — a mid-run node KILL recovered by deterministic replay from the
+last checkpoint (engine/checkpoint.py) and validated bit-for-bit against
+a fault-free oracle run, a STRAGGLE window and a PARTITION window (both
+gated inside the jitted tick, work delayed never aborted).  Records the
+recovery cost (``recovery_lag_ticks``) and the in-tick fault counters
+into ``faults_smoke.json``; the RECOVERY watchdog bit (obs/report.py)
+rides the exit code.  EXPERIMENTS.md has the kill-a-node recipe.
+
 With ``--scaling-grid`` the script runs the cluster scaling surface: a
 virtual-node grid (1/2/4/8, clamped to the device count) x two per-node
 batch shapes sized by the obs/xmeter.py ``fit_batch`` footprint model,
@@ -66,7 +76,7 @@ import time
 # --xla_force_host_platform_device_count only takes effect before the
 # jax backend initialises (imports below may touch it), so the flag is
 # set from argv BEFORE `import jax` — the same trick as tests/conftest.py
-if "--scaling-grid" in sys.argv and \
+if ("--scaling-grid" in sys.argv or "--faults" in sys.argv) and \
         "xla_force_host_platform_device_count" not in \
         os.environ.get("XLA_FLAGS", ""):
     os.environ["XLA_FLAGS"] = (
@@ -497,6 +507,147 @@ def run_scaling_grid(args, out_dir: str = "results",
     return code
 
 
+def run_faults(args, out_dir: str = "results", history: bool = True) -> int:
+    """--faults: deterministic fault plane + recovery smoke
+    (Config.faults, deneva_tpu/faults/).
+
+    Three scenarios on a small 2-node sharded CALVIN cell (CALVIN so the
+    per-node epoch log, ``arr_fault_elog_*``, is live):
+
+    - KILL: node 1 dies at the mid-run tick boundary; the host driver
+      (faults/recovery.py) recovers its shard slice by deterministic
+      replay from the last checkpoint (``Config.checkpoint_every``,
+      engine/checkpoint.py) and validates it — epoch log included —
+      bit-for-bit against the pre-crash slice.  The recovered run's
+      [summary] must then match a fault-free oracle run of the same
+      config on every integer counter; ``recovery_lag_ticks`` is the
+      recovery COST (ticks replayed).
+    - STRAGGLE: one node freezes for a window; the tick gates its new
+      admissions/requests/finishing (work delayed, never aborted) and
+      the run still commits.
+    - PARTITION: a node pair loses its link for a window; cross-pair
+      new requests are withheld symmetrically and the run still commits.
+
+    Writes ``<out-dir>/faults_smoke.json`` and appends a
+    ``fault_recovery`` history record (no commits_per_tick cells, so
+    the obs/regress.py gate treats it as metadata).  Exit code ORs the
+    RECOVERY watchdog bit (obs/report.py) on any parity failure."""
+    from deneva_tpu import faults as faults_mod
+    from deneva_tpu.obs import report as obs_report
+    from deneva_tpu.parallel.sharded import ShardedEngine
+
+    if jax.device_count() < 2:
+        print("[faults] needs >= 2 devices")
+        return 1
+
+    def fault_cfg(faults=(), checkpoint_every=0):
+        return Config(cc_alg="CALVIN", node_cnt=2, part_cnt=2,
+                      batch_size=64, part_per_txn=2, faults=faults,
+                      checkpoint_every=checkpoint_every, **GRID_KW)
+
+    ticks = args.ticks
+    mid = ticks // 2
+    code = 0
+    doc_scen = {}
+
+    # --- KILL: recover-by-replay, then bit-parity vs the oracle -------
+    cfg = fault_cfg(faults=(("kill", 1, mid),),
+                    checkpoint_every=max(2, ticks // 8))
+    eng = ShardedEngine(cfg)
+    ckpt_dir = os.path.join(out_dir, "faults_ckpt")
+    os.makedirs(ckpt_dir, exist_ok=True)
+    t0 = time.perf_counter()
+    state, counters = faults_mod.run_with_faults(eng, ticks,
+                                                 ckpt_dir=ckpt_dir)
+    wall = time.perf_counter() - t0
+    merged = {**eng.summary(state), **counters}
+    # the oracle: the SAME config run without the host-side kill (a
+    # kill spec has no in-tick effect, so the jitted tick is identical)
+    oracle_eng = ShardedEngine(cfg)
+    o_state = oracle_eng.init_state()
+    oracle_eng._build()
+    for _ in range(ticks):
+        o_state = oracle_eng._jit_tick(o_state)
+    oracle = oracle_eng.summary(o_state)
+    diff = sorted(k for k in oracle
+                  if isinstance(oracle[k], (int, np.integer))
+                  and k in merged and int(merged[k]) != int(oracle[k]))
+    parity = not diff and counters["recovery_replay_ok"] == 1 \
+        and counters["recovery_elog_ok"] == 1
+    _, wd = obs_report.watchdog(merged)
+    if not parity or wd & obs_report.RECOVERY:
+        code |= obs_report.RECOVERY
+        for k in diff:
+            print(f"[faults] kill PARITY MISMATCH {k}: "
+                  f"recovered={merged[k]} oracle={oracle[k]}")
+    print(f"[faults] kill parity={'OK' if parity else 'MISMATCH'} "
+          f"recovery_lag_ticks={counters['recovery_lag_ticks']} "
+          f"ckpt_saves={counters['ckpt_save_cnt']} "
+          f"ckpt_restores={counters['ckpt_restore_cnt']} "
+          f"commits={int(merged['txn_cnt'])} "
+          f"(oracle {int(oracle['txn_cnt'])})")
+    doc_scen["kill"] = {
+        "kill_tick": mid, "parity": parity,
+        "recovery_lag_ticks": counters["recovery_lag_ticks"],
+        "fault_replay_ticks": counters["fault_replay_ticks"],
+        "ckpt_save_cnt": counters["ckpt_save_cnt"],
+        "ckpt_restore_cnt": counters["ckpt_restore_cnt"],
+        "commits": int(merged["txn_cnt"]),
+        "watchdog": wd,
+        "wall_seconds": round(wall, 3),
+    }
+
+    # --- STRAGGLE / PARTITION: in-tick gating, delay-never-abort ------
+    win = (mid, mid + max(4, ticks // 8))
+    for name, spec in (("straggle", ("straggle", 1, *win)),
+                       ("partition", ("partition", 0, 1, *win))):
+        cfg = fault_cfg(faults=(spec,))
+        eng = ShardedEngine(cfg)
+        state = eng.run(ticks)
+        s = eng.summary(state)
+        _, wd = obs_report.watchdog(s)
+        ok = int(s["txn_cnt"]) > 0 and int(s["fault_req_blocked_cnt"]) > 0
+        if not ok:
+            code |= obs_report.RECOVERY
+        print(f"[faults] {name} window={list(win)} "
+              f"{'OK' if ok else 'DEAD'}: "
+              f"commits={int(s['txn_cnt'])} "
+              f"req_blocked={int(s['fault_req_blocked_cnt'])} "
+              f"fin_deferred={int(s['fault_fin_deferred_cnt'])} "
+              f"stall_ticks={int(s['fault_stall_ticks'])}")
+        doc_scen[name] = {
+            "window": list(win), "commits": int(s["txn_cnt"]),
+            "fault_req_blocked_cnt": int(s["fault_req_blocked_cnt"]),
+            "fault_fin_deferred_cnt": int(s["fault_fin_deferred_cnt"]),
+            "fault_stall_ticks": int(s["fault_stall_ticks"]),
+            "watchdog": wd,
+        }
+
+    doc = {
+        "metric": "fault_recovery",
+        "value": doc_scen["kill"]["recovery_lag_ticks"],
+        "unit": "recovery_lag_ticks",
+        "ticks": ticks,
+        "scenarios": doc_scen,
+        "note": "kill/straggle/partition smoke on the 2-node sharded "
+                "CALVIN cell; kill recovers by deterministic replay "
+                "from the last checkpoint and must match the "
+                "fault-free oracle bit-for-bit on every integer "
+                "counter; value = ticks replayed to recover (the "
+                "recovery cost)",
+    }
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, "faults_smoke.json")
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(json.dumps({k: v for k, v in doc.items() if k != "scenarios"}))
+    print(f"[faults] smoke written: {path}")
+    if history:
+        _append_history(doc, fault_cfg(faults=(("kill", 1, mid),)),
+                        out_dir)
+    return code
+
+
 def run_flight(args, out_dir: str = "results", history: bool = True) -> int:
     """--flight: transaction flight recorder sweep (obs/flight.py).
 
@@ -806,6 +957,14 @@ def _cli():
                    help="cap on the fit_batch-derived per-node batch "
                         "shape (keeps the CPU smoke fast; raise on "
                         "real chips)")
+    p.add_argument("--faults", action="store_true",
+                   help="fault-plane smoke: kill/straggle/partition "
+                        "scenarios on the 2-node sharded CALVIN cell; "
+                        "the kill recovers by deterministic replay from "
+                        "the last checkpoint and must match the "
+                        "fault-free oracle bit-for-bit (exit carries "
+                        "the RECOVERY watchdog bit on any parity "
+                        "failure); writes faults_smoke.json")
     p.add_argument("--flight", action="store_true",
                    help="transaction flight recorder sweep: per-alg "
                         "full-sampling lifecycle spans, exact phase/"
@@ -839,6 +998,9 @@ if __name__ == "__main__":
     if _args.offered_load:
         raise SystemExit(run_offered_load(_args, out_dir=_args.out_dir,
                                           history=not _args.no_history))
+    if _args.faults:
+        raise SystemExit(run_faults(_args, out_dir=_args.out_dir,
+                                    history=not _args.no_history))
     if _args.flight:
         raise SystemExit(run_flight(_args, out_dir=_args.out_dir,
                                     history=not _args.no_history))

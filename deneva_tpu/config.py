@@ -425,6 +425,43 @@ class Config:
     #: extra device arrays and a byte-identical [summary] line.
     mesh: bool = _optin(False, {"mesh": True}, engines=("sharded_tick",))
 
+    #: deterministic fault plane (deneva_tpu/faults/): a static, seeded
+    #: schedule of injected failures, each a tuple —
+    #:   ("kill", node, tick)              crash node at tick (host-side:
+    #:                                     its shard state is wiped and
+    #:                                     recovered by deterministic
+    #:                                     replay, faults/recovery.py);
+    #:   ("straggle", node, t0, t1)        node does no NEW work in
+    #:                                     [t0, t1): admits nothing,
+    #:                                     launches no requests, defers
+    #:                                     finishing; peers withhold NEW
+    #:                                     requests destined to it;
+    #:   ("partition", a, b, t0, t1)       links a<->b drop NEW requests
+    #:                                     and defer cross-pair commits
+    #:                                     in [t0, t1).
+    #: HELD entries always ship (owner lock state must stay consistent),
+    #: so injected faults DELAY work deterministically — they never abort
+    #: or lose it.  Windows are trace-time constants: the traced tick
+    #: indexes a baked schedule, so the jaxpr is shape-stable and the
+    #: off path (()) carries zero extra arrays and stays byte-identical.
+    #: Sharded engine only (a single node has no peers to lose).
+    faults: tuple = _optin((), {"faults": (("straggle", 1, 2, 6),)},
+                           engines=("sharded_tick",))
+    #: CALVIN epoch-log ring slots per node (admitted txn pool ids + ts
+    #: per admission epoch, keep-last) — the deterministic replay log of
+    #: the Calvin recovery story (PAPERS.md #3).  Carried only when
+    #: ``faults`` is non-empty and the plugin admits by epoch.
+    fault_elog_cap: int = 1 << 12
+
+    #: host-side checkpoint cadence for fault/soak drivers
+    #: (engine/checkpoint.py, faults/recovery.py): every this-many ticks
+    #: the host saves the carry pytree, so a kill can be answered by
+    #: restore + replay of only the suffix.  Pure run-protocol knob: the
+    #: tick jaxpr is untouched at ANY value (the certifier records the
+    #: flag as inert, which is the honest verdict — there is no on-path
+    #: device work to certify).  0 = never.
+    checkpoint_every: int = _optin(0, {"checkpoint_every": 4})
+
     #: compile & memory observatory (deneva_tpu/obs/xmeter.py): per-entry
     #: recompile sentinel (compile counts + trigger signatures; a steady
     #: run must report ZERO post-warmup recompiles), HBM footprint ledger
@@ -499,6 +536,38 @@ class Config:
         assert self.heatmap_bins >= 0 and \
             (self.heatmap_bins & (self.heatmap_bins - 1)) == 0, \
             "heatmap_bins must be 0 or a power of two"
+        if self.faults:
+            assert self.node_cnt > 1, \
+                "faults need a multi-node topology (sharded engine)"
+            assert self.net_delay_ticks == 0, \
+                "faults compose with the D=0 exchange only: the delay " \
+                "latches track one outstanding round trip per txn and " \
+                "a withheld request would desynchronize them"
+            assert self.fault_elog_cap > 0
+            for spec in self.faults:
+                assert isinstance(spec, tuple) and spec, spec
+                kind = spec[0]
+                if kind == "kill":
+                    assert len(spec) == 3, spec
+                    node, tick = spec[1:]
+                    assert 0 <= node < self.node_cnt, spec
+                    assert tick >= 0, spec
+                elif kind == "straggle":
+                    assert len(spec) == 4, spec
+                    node, t0, t1 = spec[1:]
+                    assert 0 <= node < self.node_cnt, spec
+                    assert 0 <= t0 < t1, spec
+                elif kind == "partition":
+                    assert len(spec) == 5, spec
+                    a, b, t0, t1 = spec[1:]
+                    assert 0 <= a < self.node_cnt, spec
+                    assert 0 <= b < self.node_cnt and a != b, spec
+                    assert 0 <= t0 < t1, spec
+                else:
+                    raise AssertionError(
+                        f"unknown fault kind {kind!r} in {spec!r}: "
+                        "expected kill | straggle | partition")
+        assert self.checkpoint_every >= 0
         if self.net_delay_ticks > 0:
             # delay models message transit between shards; a single node
             # has no remote accesses for it to act on

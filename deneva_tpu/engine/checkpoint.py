@@ -1,0 +1,112 @@
+"""Bit-exact checkpoint/restore of the engine carry pytree.
+
+Works for both engines — ``EngineState`` (engine/scheduler.py) and
+``ShardState`` (parallel/sharded.py) are plain pytrees, and the tick
+certifier (lint/certify.py) already proves the carry is a donated fixed
+point of its own type, i.e. a clean serializable snapshot boundary
+(ROADMAP item 5).  Because every run input lives IN the carry — the
+traffic plane's arrival PRNG key (``arr_arrival_key``), pool cursor,
+tick and timestamp counters all ride the stats/state leaves — a restored
+carry resumes the run bit-exactly: arrival streams, admission order and
+the ``[summary]`` line all match an uninterrupted run
+(tests/test_checkpoint.py).
+
+Format: one ``.npz`` holding every leaf as ``leaf_<i>`` plus a ``_meta``
+JSON blob (format version, config fingerprint, per-leaf shape/dtype and
+crc32).  Restore verifies ALL of it against a template state from
+``engine.init_state()`` and fails loudly with :class:`ValueError` on a
+truncated file, a corrupted leaf, or a checkpoint from a different
+config/geometry — never a silent wrong resume.  No dependencies beyond
+numpy.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import zlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: bump when the on-disk layout changes incompatibly
+FORMAT = 1
+
+
+def fingerprint(cfg) -> str:
+    """Config identity a checkpoint is bound to (geometry + knobs —
+    ``repr`` of the frozen dataclass covers every field)."""
+    if cfg is None:
+        return ""
+    return hashlib.sha1(repr(cfg).encode()).hexdigest()[:16]
+
+
+def save(path: str, state, cfg=None) -> str:
+    """Write the carry pytree to ``path`` (.npz).  Returns ``path``."""
+    leaves, _ = jax.tree_util.tree_flatten(state)
+    arrs = [np.asarray(x) for x in leaves]
+    meta = {
+        "format": FORMAT,
+        "n_leaves": len(arrs),
+        "fingerprint": fingerprint(cfg),
+        "leaves": [{"shape": list(a.shape), "dtype": str(a.dtype),
+                    "crc": zlib.crc32(a.tobytes())} for a in arrs],
+    }
+    blob = np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8)
+    np.savez(path, _meta=blob,
+             **{f"leaf_{i:05d}": a for i, a in enumerate(arrs)})
+    return path
+
+
+def restore(path: str, template, cfg=None):
+    """Load a checkpoint into the pytree structure of ``template`` (a
+    fresh ``engine.init_state()``), verifying format version, config
+    fingerprint, leaf count, every leaf's shape/dtype against BOTH the
+    template and the stored metadata, and every leaf's crc32.  Raises
+    :class:`ValueError` on any mismatch or unreadable/truncated file."""
+    t_leaves, treedef = jax.tree_util.tree_flatten(template)
+    try:
+        with np.load(path) as z:
+            meta = json.loads(bytes(np.asarray(z["_meta"])))
+            if meta.get("format") != FORMAT:
+                raise ValueError(
+                    f"checkpoint {path}: format {meta.get('format')!r} "
+                    f"!= supported {FORMAT}")
+            if meta["n_leaves"] != len(t_leaves):
+                raise ValueError(
+                    f"checkpoint {path}: {meta['n_leaves']} leaves but the "
+                    f"template carry has {len(t_leaves)} — different "
+                    "config/geometry")
+            fp = fingerprint(cfg)
+            if fp and meta.get("fingerprint") and meta["fingerprint"] != fp:
+                raise ValueError(
+                    f"checkpoint {path}: config fingerprint "
+                    f"{meta['fingerprint']} != this run's {fp}")
+            arrs = []
+            for i, (tl, lm) in enumerate(zip(t_leaves, meta["leaves"])):
+                a = z[f"leaf_{i:05d}"]
+                want_shape = tuple(np.shape(tl))
+                want_dtype = np.asarray(tl).dtype
+                if a.shape != want_shape or tuple(lm["shape"]) != want_shape:
+                    raise ValueError(
+                        f"checkpoint {path} leaf {i}: shape {a.shape} / "
+                        f"stored {tuple(lm['shape'])} != template "
+                        f"{want_shape}")
+                if str(a.dtype) != lm["dtype"] or a.dtype != want_dtype:
+                    raise ValueError(
+                        f"checkpoint {path} leaf {i}: dtype {a.dtype} / "
+                        f"stored {lm['dtype']} != template {want_dtype}")
+                if zlib.crc32(a.tobytes()) != lm["crc"]:
+                    raise ValueError(
+                        f"checkpoint {path} leaf {i}: crc32 mismatch — "
+                        "corrupted checkpoint")
+                arrs.append(a)
+    except ValueError:
+        raise
+    except Exception as e:  # truncated zip, missing keys, bad JSON, ...
+        raise ValueError(
+            f"checkpoint {path} unreadable (truncated or corrupt): "
+            f"{type(e).__name__}: {e}") from e
+    return jax.tree_util.tree_unflatten(
+        treedef, [jnp.asarray(a) for a in arrs])

@@ -1,0 +1,24 @@
+"""Deterministic fault plane + recovery (ROADMAP items 4/5).
+
+Two halves:
+
+- :mod:`deneva_tpu.faults.plan` — the seeded, jit-safe fault schedule
+  (``Config.faults``): straggler and partition windows become trace-time
+  availability masks gating NEW work inside the sharded tick, and a
+  ``chaos_plan`` helper draws a deterministic pseudo-random schedule from
+  a seed.
+- :mod:`deneva_tpu.faults.recovery` — the host-side kill driver: at a
+  ``("kill", node, tick)`` event the victim's shard slice is wiped and
+  reconstructed by deterministic replay (optionally from the last
+  checkpoint, engine/checkpoint.py), validated bit-for-bit against the
+  pre-crash slice and the CALVIN epoch log, then spliced back into the
+  live cluster — the Calvin recovery story (PAPERS.md #3) made
+  measurable.
+"""
+
+from deneva_tpu.faults.plan import availability, chaos_plan, kill_events
+from deneva_tpu.faults.recovery import (HOST_COUNTERS, init_counters,
+                                        recover_node, run_with_faults)
+
+__all__ = ["availability", "chaos_plan", "kill_events", "HOST_COUNTERS",
+           "init_counters", "recover_node", "run_with_faults"]

@@ -1,0 +1,112 @@
+"""Jit-safe fault schedule (``Config.faults``).
+
+Every fault spec is a plain tuple of Python ints (validated by
+``Config.__post_init__``), so the whole schedule is a trace-time
+constant: :func:`availability` compiles each window into comparisons
+against the traced tick, the jaxpr shape never depends on the schedule
+contents, and the off path (``faults == ()``) adds zero equations.
+
+Semantics (the tick gates NEW work only — parallel/sharded.py):
+
+- ``("straggle", node, t0, t1)``: in ``[t0, t1)`` the node admits no
+  fresh transactions, launches no new access requests, and defers its
+  finishing txns; every peer withholds NEW requests destined to it.
+- ``("partition", a, b, t0, t1)``: in ``[t0, t1)`` NEW requests between
+  ``a`` and ``b`` (both directions) are withheld and cross-pair commits
+  defer.
+- ``("kill", node, tick)``: no in-tick effect — the host driver
+  (faults/recovery.py) wipes and recovers the node between ticks.
+
+HELD entries always ship: a withheld held lock would be invisible to
+its row owner, which could then grant the row to another writer and
+corrupt the schedule.  Faults therefore DELAY work deterministically;
+nothing is ever aborted or lost on their account.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+KINDS = ("kill", "straggle", "partition")
+
+
+def kill_events(faults: tuple) -> list:
+    """``[(tick, node), ...]`` sorted by tick — the host driver's agenda."""
+    return sorted((spec[2], spec[1]) for spec in faults
+                  if spec[0] == "kill")
+
+
+def window_span(faults: tuple) -> int:
+    """Last tick any straggle/partition window is still active (0 when
+    none) — lets drivers size runs to outlive every injected window."""
+    ends = [spec[-1] for spec in faults if spec[0] != "kill"]
+    return max(ends) if ends else 0
+
+
+def availability(faults: tuple, t, node_id, n_nodes: int):
+    """Per-tick availability masks for NEW work, from this node's view.
+
+    Returns ``(dest_ok, self_ok)``: ``dest_ok[j]`` is True iff this node
+    may ship new requests to node ``j`` at tick ``t``; ``self_ok`` is
+    True iff this node itself is doing new work (False inside its own
+    straggle window).  Pure function of the traced ``(t, node_id)`` and
+    the baked schedule — safe inside jit/shard_map.
+    """
+    dest_ok = jnp.ones((n_nodes,), dtype=bool)
+    self_ok = jnp.asarray(True)
+    idx = jnp.arange(n_nodes, dtype=jnp.int32)
+    for spec in faults:
+        kind = spec[0]
+        if kind == "kill":
+            continue
+        if kind == "straggle":
+            _, nd, t0, t1 = spec
+            win = (t >= t0) & (t < t1)
+            dest_ok = dest_ok & ~(win & (idx == nd))
+            self_ok = self_ok & ~(win & (node_id == nd))
+        elif kind == "partition":
+            _, a, b, t0, t1 = spec
+            win = (t >= t0) & (t < t1)
+            cut = ((node_id == a) & (idx == b)) \
+                | ((node_id == b) & (idx == a))
+            dest_ok = dest_ok & ~(win & cut)
+    return dest_ok, self_ok
+
+
+def chaos_plan(seed: int, n_nodes: int, n_ticks: int, n_events: int = 3,
+               kinds: tuple = ("kill", "straggle", "partition")) -> tuple:
+    """Draw a deterministic pseudo-random fault schedule from a seed.
+
+    Uses ``numpy.random.RandomState`` (stable across numpy versions for
+    these calls), so the same ``(seed, n_nodes, n_ticks, n_events)``
+    always yields the same schedule — chaos runs are replayable by
+    construction.  Events land in the middle 60% of the run (recovery
+    and drain both stay observable), at most one kill per (node, tick).
+    """
+    assert n_nodes > 1 and n_ticks >= 10 and n_events > 0
+    rng = np.random.RandomState(seed)
+    lo, hi = max(1, n_ticks // 5), max(2, (4 * n_ticks) // 5)
+    out, seen_kills = [], set()
+    for _ in range(n_events):
+        kind = kinds[rng.randint(len(kinds))]
+        if kind == "kill":
+            node = int(rng.randint(n_nodes))
+            tick = int(rng.randint(lo, hi))
+            if (node, tick) in seen_kills:
+                continue
+            seen_kills.add((node, tick))
+            out.append(("kill", node, tick))
+        elif kind == "straggle":
+            node = int(rng.randint(n_nodes))
+            t0 = int(rng.randint(lo, hi))
+            t1 = t0 + 1 + int(rng.randint(max(1, n_ticks // 8)))
+            out.append(("straggle", node, t0, t1))
+        else:
+            a = int(rng.randint(n_nodes))
+            b = int(rng.randint(n_nodes - 1))
+            b = b + (b >= a)
+            t0 = int(rng.randint(lo, hi))
+            t1 = t0 + 1 + int(rng.randint(max(1, n_ticks // 8)))
+            out.append(("partition", a, b, t0, t1))
+    return tuple(out)

@@ -1,0 +1,143 @@
+"""Host-side kill-a-node driver: deterministic replay recovery.
+
+The sharded engine's ``run`` is a host loop stepping one jitted SPMD
+tick at a time, so node death is orchestrated BETWEEN ticks: at a
+``("kill", node, tick)`` event the victim's slice of the node-stacked
+carry is harvested (the pre-crash oracle — in a real cluster this is
+exactly the state that was lost), wiped to init values, and
+reconstructed by deterministic replay — re-running the same jitted tick
+from tick 0 (or from the last checkpoint, engine/checkpoint.py, paying
+only the suffix) over the same query pool and the same baked fault
+schedule.  The tick is a pure function of its carry, so the replayed
+cluster state at the kill tick is bit-identical to the pre-crash one;
+the victim's slice (including its CALVIN epoch log,
+``arr_fault_elog_*``) is validated leaf-for-leaf against the harvested
+oracle and spliced back into the live cluster, which then proceeds.
+This is the Calvin recovery claim (PAPERS.md #3) operationalized: a
+deterministic epoch log makes failed-node recovery a pure replay whose
+cost is LAG (``recovery_lag_ticks`` — ticks re-executed), never
+divergence — the recovered run's ``[summary]`` matches the fault-free
+oracle bit-for-bit (bench.py --faults, scripts/check.sh).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+
+from deneva_tpu.engine import checkpoint
+from deneva_tpu.faults import plan as fault_plan
+
+#: host-side counters merged into the run summary by the driver; the
+#: ``fault_``/``ckpt_``/``recovery_`` prefixes pass through the
+#: [summary] line verbatim (deneva_tpu/stats.py) and the RECOVERY
+#: watchdog bit keys on fault_kill_cnt + recovery_replay_ok
+#: (obs/report.py)
+HOST_COUNTERS = ("fault_kill_cnt", "fault_replay_ticks",
+                 "recovery_lag_ticks", "recovery_replay_ok",
+                 "recovery_elog_ok", "ckpt_save_cnt", "ckpt_restore_cnt")
+
+
+def init_counters() -> dict:
+    c = {k: 0 for k in HOST_COUNTERS}
+    c["recovery_replay_ok"] = 1
+    c["recovery_elog_ok"] = 1
+    return c
+
+
+def _merge(counters: dict, info: dict) -> dict:
+    out = dict(counters)
+    for k, v in info.items():
+        if k in ("recovery_replay_ok", "recovery_elog_ok"):
+            out[k] = int(bool(out.get(k, 1)) and bool(v))
+        else:
+            out[k] = out.get(k, 0) + v
+    return out
+
+
+def _slice(state, node: int):
+    return jax.tree.map(lambda x: np.asarray(x[node]), state)
+
+
+def _splice(state, src, node: int):
+    return jax.tree.map(lambda live, s: live.at[node].set(s[node]),
+                        state, src)
+
+
+def _leaves_equal(a, b) -> bool:
+    fa = jax.tree_util.tree_leaves(a)
+    fb = jax.tree_util.tree_leaves(b)
+    return len(fa) == len(fb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(fa, fb))
+
+
+def recover_node(eng, state, node: int, tick: int, last_ckpt=None):
+    """Kill ``node`` at tick boundary ``tick`` and recover it by
+    deterministic replay.  ``last_ckpt`` is an optional ``(tick, path)``
+    of the most recent checkpoint at or before ``tick``.  Returns the
+    recovered cluster state and a host-counter info dict."""
+    eng._build()
+    # 1. harvest the pre-crash oracle (what a real cluster just lost)
+    pre = _slice(state, node)
+    # 2. the crash: the victim's slice is gone
+    state = _splice(state, eng.init_state(), node)
+    # 3. deterministic replay — checkpoint + suffix when available,
+    #    else the full prefix from tick 0
+    restored = 0
+    if last_ckpt is not None and last_ckpt[0] <= tick:
+        start, path = last_ckpt
+        rst = checkpoint.restore(path, eng.init_state(), cfg=eng.cfg)
+        restored = 1
+    else:
+        start, rst = 0, eng.init_state()
+    replay = tick - start
+    for _ in range(replay):
+        rst = eng._jit_tick(rst)
+    # 4. validate: the replayed victim slice — epoch log included — must
+    #    be bit-identical to the pre-crash oracle
+    rep = _slice(rst, node)
+    ok = _leaves_equal(pre, rep)
+    elog_keys = [k for k in rep.stats if k.startswith("arr_fault_elog")]
+    elog_ok = all(np.array_equal(pre.stats[k], rep.stats[k])
+                  for k in elog_keys) if elog_keys else ok
+    # 5. splice the recovered slice into the live cluster
+    state = _splice(state, rst, node)
+    info = {"fault_kill_cnt": 1, "fault_replay_ticks": replay,
+            "recovery_lag_ticks": replay,
+            "recovery_replay_ok": int(ok),
+            "recovery_elog_ok": int(elog_ok),
+            "ckpt_restore_cnt": restored}
+    return state, info
+
+
+def run_with_faults(eng, n_ticks: int, state=None, ckpt_dir=None):
+    """Run ``eng`` (a ShardedEngine) for ``n_ticks`` under its config's
+    fault schedule, executing kill events between ticks and saving
+    checkpoints every ``Config.checkpoint_every`` ticks when
+    ``ckpt_dir`` is given.  Straggle/partition windows need no host
+    action — the tick gates them itself.  Returns ``(state, counters)``;
+    merge ``counters`` into ``eng.summary(state)`` for the full
+    [summary] picture (they are host-side, never device arrays)."""
+    eng._build()
+    if state is None:
+        state = eng.init_state()
+    kills = fault_plan.kill_events(eng.cfg.faults)
+    counters = init_counters()
+    every = eng.cfg.checkpoint_every
+    last_ckpt = None
+    for i in range(n_ticks):
+        for kt, kn in kills:
+            if kt == i:
+                state, info = recover_node(eng, state, node=kn, tick=i,
+                                           last_ckpt=last_ckpt)
+                counters = _merge(counters, info)
+        state = eng._jit_tick(state)
+        if ckpt_dir is not None and every and (i + 1) % every == 0:
+            path = os.path.join(ckpt_dir, f"ckpt_{i + 1:06d}.npz")
+            checkpoint.save(path, state, cfg=eng.cfg)
+            counters["ckpt_save_cnt"] += 1
+            last_ckpt = (i + 1, path)
+    return state, counters

@@ -31,6 +31,11 @@ the full mask (the README watchdog table mirrors it)::
                     loads fell below IMB_JAIN_MIN (obs/mesh.py) while
                     the cluster was committing — more than half the
                     nodes effectively idle
+    RECOVERY  (64)  fault runs: a killed node's replay-recovered shard
+                    slice (or its CALVIN epoch log) failed bit-parity
+                    against the pre-crash oracle
+                    (recovery_replay_ok / recovery_elog_ok = 0,
+                    faults/recovery.py)
 
 CLI: ``python -m deneva_tpu.obs.report <run_record.json> [--json]``
 exits with the watchdog bitmask, so a CI stage can gate on it
@@ -51,6 +56,7 @@ SPILL = 4
 STARVED = 8
 OVERLOAD = 16
 IMBALANCE = 32
+RECOVERY = 64
 
 #: a zero-commit run of at least this many ticks, with abort/admission
 #: churn inside it, is flagged as live-lock
@@ -321,6 +327,26 @@ def watchdog(summary: dict, timeline: dict | None = None,
             ("IMBALANCE", f"Jain fairness {jain_v:.3f} < {IMB_JAIN_MIN} "
                           f"over per-node commit loads{strag}"))
         code |= IMBALANCE
+
+    # fault-plane recovery parity: a kill-a-node run must recover by
+    # deterministic replay to a bit-identical shard slice (and CALVIN
+    # epoch log).  Keys are host-side counters merged by the fault
+    # driver (faults/recovery.py run_with_faults) — present only for
+    # Config.faults runs with kills, so other summaries skip this.
+    kills = int(summary.get("fault_kill_cnt", 0))
+    if kills > 0:
+        replay_ok = int(summary.get("recovery_replay_ok", 0))
+        elog_ok = int(summary.get("recovery_elog_ok", 1))
+        if replay_ok < 1 or elog_ok < 1:
+            what = ("replayed shard slice" if replay_ok < 1
+                    else "CALVIN epoch log")
+            findings.append(
+                ("RECOVERY", f"{what} diverged from the pre-crash "
+                             f"oracle after {kills} kill(s) "
+                             f"({int(summary.get('fault_replay_ticks', 0))} "
+                             f"ticks replayed) — recovery is not "
+                             f"deterministic"))
+            code |= RECOVERY
     return findings, code
 
 

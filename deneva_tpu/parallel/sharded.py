@@ -61,6 +61,7 @@ from deneva_tpu.engine.scheduler import (STAT_KEYS_F32, STAT_KEYS_I32,  # noqa: 
                                          record_commit_latency,
                                          track_parts_touched,
                                          track_state_latencies)
+from deneva_tpu.faults import plan as fault_plan
 from deneva_tpu.obs import flight as obs_flight
 from deneva_tpu.obs import mesh as obs_mesh
 from deneva_tpu.obs import trace as obs_trace
@@ -239,6 +240,28 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
         stats = bump(stats, "local_txn_start_cnt", n_free, measuring)
         stats = obs_flight.note_admit(stats, free, t, qwait)
 
+        if cfg.faults and plugin.epoch_admission:
+            # CALVIN epoch log (faults/recovery.py): admitted txn pool
+            # ids + their ts, in admission order, keep-last ring — the
+            # deterministic replay log of the Calvin recovery story
+            # (PAPERS.md #3).  Ring discipline as in append_log_ring:
+            # keep the last fault_elog_cap records; dead lanes scatter
+            # to DISTINCT out-of-bounds cells so unique_indices holds.
+            ecap = cfg.fault_elog_cap
+            erank = jnp.cumsum(free.astype(jnp.int32)) \
+                - free.astype(jnp.int32)
+            ekeep = free & (erank >= n_free - ecap)
+            epos = jnp.where(ekeep,
+                             (stats["fault_elog_lsn"] + erank) % ecap,
+                             ecap + jnp.arange(B, dtype=jnp.int32))
+            stats = {**stats,
+                     "arr_fault_elog_txn": stats["arr_fault_elog_txn"]
+                     .at[epos].set(pool_idx, mode="drop",
+                                   unique_indices=True),
+                     "arr_fault_elog_ts": stats["arr_fault_elog_ts"]
+                     .at[epos].set(ts, mode="drop", unique_indices=True),
+                     "fault_elog_lsn": stats["fault_elog_lsn"] + n_free}
+
         backoff_until = txn.backoff_until
         if plugin.epoch_admission and workload.recon_types:
             # defer one epoch + the request transit (net_delay mode), so
@@ -318,6 +341,35 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
                                                             READ_UNCOMMITTED)),
             window=R if plugin.request_all else cfg.acquire_window)
         held, req = ent.held, ent.req
+        if cfg.faults:
+            # ---- fault plane (deneva_tpu/faults/plan.py): straggle /
+            # partition windows gate NEW work only.  HELD entries always
+            # ship — a withheld held lock would be invisible to its row
+            # owner, which could grant the row elsewhere and corrupt the
+            # schedule.  A withheld request gets no decision, so its txn
+            # stalls deterministically and retries: faults DELAY work,
+            # they never abort or lose it (the routing-overflow
+            # deferral contract).  Windows are baked constants of the
+            # schedule; only (t, node_id) are traced.
+            dest_ok, self_ok = fault_plan.availability(
+                cfg.faults, t, node_id, n_nodes)
+            ent_dest = txn.keys.reshape(-1) % n_parts
+            ent_ok = dest_ok[ent_dest] & self_ok
+            stats = bump(stats, "fault_req_blocked_cnt",
+                         jnp.sum((req & ~ent_ok).astype(jnp.int32)),
+                         measuring)
+            req = req & ent_ok
+            # finishing defers while any footprint entry's owner (or the
+            # node itself) is unavailable — commit effects would cross a
+            # dead link
+            in_fp = (ridx < txn.n_req[:, None]).reshape(-1)
+            txn_ok = jnp.all((ent_ok | ~in_fp).reshape(B, R), axis=1)
+            stats = bump(stats, "fault_fin_deferred_cnt",
+                         jnp.sum((finishing & ~txn_ok).astype(jnp.int32)),
+                         measuring)
+            finishing = finishing & txn_ok
+            stats = bump(stats, "fault_stall_ticks",
+                         (~self_ok).astype(jnp.int32), measuring)
         if dly:
             # finish gate: a remote-touching txn's prepare request reaches
             # its owners fin_delay ticks after it finishes executing; the
@@ -1203,10 +1255,17 @@ class ShardedEngine:
             # sort-index width (cc/twopl.py); scale past this bound needs a
             # hierarchical exchange, not a bigger buffer.
             self.cap = B * R
-            assert N * B * R <= 1 << 23, (
-                f"CALVIN worst-case exchange {N}x{B}x{R} exceeds the "
-                "2^23-entry arbitration bound; lower batch_size or shard "
-                "the epoch")
+            if N * B * R > 1 << 23:
+                raise ValueError(
+                    f"CALVIN worst-case exchange overflows the packed "
+                    f"arbitration index: node_cnt={N} x batch_size={B} x "
+                    f"max_req={R} = {N * B * R} owner-side entries "
+                    f"exceeds the 2^23 bound (cc/twopl.py packed sort "
+                    f"keys).  Lower batch_size, or shard the epoch by "
+                    f"setting seq_batch_size below the current "
+                    f"epoch_size={cfg.epoch_size}; scale past this bound "
+                    f"needs the hierarchical exchange of ROADMAP item 2, "
+                    f"not a bigger buffer.")
 
         self._tick_inner = None  # built lazily per pool shard inside spmd
 
@@ -1252,7 +1311,20 @@ class ShardedEngine:
                           if cfg.net_delay_ticks > 0 else {}),
                        # mesh observatory planes ({} when Config.mesh
                        # is off — the default carries nothing)
-                       **obs_mesh.init_mesh(cfg, N)},
+                       **obs_mesh.init_mesh(cfg, N),
+                       # fault plane counters + CALVIN epoch-log ring
+                       # (Config.faults; the default () carries nothing)
+                       **({"fault_req_blocked_cnt": jnp.zeros((), jnp.int32),
+                           "fault_fin_deferred_cnt": jnp.zeros((), jnp.int32),
+                           "fault_stall_ticks": jnp.zeros((), jnp.int32)}
+                          if cfg.faults else {}),
+                       **({"arr_fault_elog_txn":
+                           jnp.full(cfg.fault_elog_cap, -1, jnp.int32),
+                           "arr_fault_elog_ts":
+                           jnp.full(cfg.fault_elog_cap, -1, jnp.int32),
+                           "fault_elog_lsn": jnp.zeros((), jnp.int32)}
+                          if cfg.faults and self.plugin.epoch_admission
+                          else {})},
                 tick=jnp.zeros((), jnp.int32),
                 pool_cursor=jnp.zeros((), jnp.int32),
                 ts_counter=jnp.ones((), jnp.int32),

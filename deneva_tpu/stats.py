@@ -155,6 +155,17 @@ def reference_summary(s: dict, wall_seconds: float | None = None) -> dict:
     for k in sorted(s):
         if k.startswith(_MESH_PREFIXES) and k not in out:
             out[k] = s[k]
+    # fault plane + recovery keys (Config.faults / checkpoint_every,
+    # deneva_tpu/faults/, engine/checkpoint.py): in-tick gating counters,
+    # host-side kill/replay/checkpoint counters and the replay-parity
+    # verdict bits pass through verbatim (counts and 0/1 flags — never
+    # time-scaled; the RECOVERY watchdog bit in obs/report.py keys on
+    # them).  Present only for fault runs, so the default line stays
+    # byte-identical.
+    _FAULT_PREFIXES = ("fault_", "ckpt_", "recovery_")
+    for k in sorted(s):
+        if k.startswith(_FAULT_PREFIXES) and k not in out:
+            out[k] = s[k]
     for k in sorted(s):
         if k.startswith("famlat") and k not in out:
             out[k] = s[k] * tick_sec if isinstance(s[k], float) else s[k]

@@ -202,6 +202,30 @@ if [ "$mesh_rc" -ne 0 ]; then
     exit "$mesh_rc"
 fi
 
+echo "== fault smoke (kill-a-node replay recovery) =="
+# the deterministic fault plane (Config.faults, deneva_tpu/faults/) on
+# the 2-node sharded CALVIN cell: a mid-run kill must recover by
+# deterministic replay from the last checkpoint to a [summary] that is
+# bit-identical to the fault-free oracle (the exit code carries the
+# RECOVERY watchdog bit, 64, on any parity failure), and straggle /
+# partition windows must gate work without aborting it; the printed
+# parity line is the recovered-vs-oracle verdict
+flt_dir=$(mktemp -d)
+env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+    python bench.py --faults --ticks 60 --no-history --out-dir "$flt_dir" \
+    | tee "$flt_dir/faults.log"
+faults_rc=${PIPESTATUS[0]}
+if [ "$faults_rc" -eq 0 ]; then
+    grep -q 'kill parity=OK' "$flt_dir/faults.log"
+    faults_rc=$?
+fi
+rm -rf "$flt_dir"
+if [ "$faults_rc" -ne 0 ]; then
+    echo "fault smoke FAILED (recovery parity bitmask rc=$faults_rc)"
+    exit "$faults_rc"
+fi
+
 echo "== bench regression gate =="
 # gate the latest trajectory point (committed BENCH_r*.json snapshots +
 # any results/bench_history.jsonl) against the median of its priors;

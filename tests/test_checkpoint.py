@@ -1,0 +1,167 @@
+"""Bit-exact checkpoint/restore (engine/checkpoint.py): save -> restore
+round-trips the carry pytree leaf-for-leaf for every CC plugin, the
+resumed run's [summary] matches continuing in memory, and a damaged or
+mismatched checkpoint fails loudly with ValueError — never a silent
+wrong resume."""
+
+import json
+import shutil
+
+import numpy as np
+import pytest
+import jax
+
+from deneva_tpu.config import Config
+from deneva_tpu.engine import checkpoint
+from deneva_tpu.engine.scheduler import Engine
+from deneva_tpu.parallel.sharded import ShardedEngine
+
+# every plugin round-trips; the tier-1 gate keeps the two extreme
+# plugins (NO_WAIT's lock path, CALVIN's epoch path — the recovery
+# substrate) and the arrival-fixture WAIT_DIE resume below, while the
+# other engine compiles ride the slow tier (the tier-1 wall budget is
+# nearly spent — ROADMAP.md)
+ALGS = ("NO_WAIT",
+        pytest.param("WAIT_DIE", marks=pytest.mark.slow),
+        pytest.param("TIMESTAMP", marks=pytest.mark.slow),
+        pytest.param("MVCC", marks=pytest.mark.slow),
+        pytest.param("OCC", marks=pytest.mark.slow),
+        pytest.param("MAAT", marks=pytest.mark.slow),
+        "CALVIN")
+
+
+def small_cfg(**kw):
+    base = dict(cc_alg="WAIT_DIE", batch_size=32,
+                synth_table_size=1 << 10, req_per_query=4,
+                query_pool_size=1 << 9, zipf_theta=0.6,
+                tup_read_perc=0.5, warmup_ticks=0)
+    base.update(kw)
+    return Config(**base)
+
+
+def _leaves(state):
+    return jax.tree_util.tree_leaves(state)
+
+
+def assert_states_equal(a, b):
+    fa, fb = _leaves(a), _leaves(b)
+    assert len(fa) == len(fb)
+    for i, (x, y) in enumerate(zip(fa, fb)):
+        assert np.array_equal(np.asarray(x), np.asarray(y)), f"leaf {i}"
+
+
+@pytest.mark.parametrize("alg", ALGS)
+def test_round_trip_every_plugin(alg, tmp_path):
+    """save -> restore is leaf-for-leaf bit-exact mid-run, and the
+    restored carry resumes to the SAME [summary] as continuing the
+    in-memory state — for all seven CC plugins."""
+    eng = Engine(small_cfg(cc_alg=alg))
+    st = eng.run(5)
+    path = checkpoint.save(str(tmp_path / "ck.npz"), st, cfg=eng.cfg)
+    rst = checkpoint.restore(path, eng.init_state(), cfg=eng.cfg)
+    assert_states_equal(st, rst)
+    cont = eng.run(5, st)
+    resumed = eng.run(5, rst)
+    assert_states_equal(cont, resumed)
+    # the counter summaries match too (the *_util keys sample the host
+    # clock at call time and are excluded from the bit-parity claim)
+    s1, s2 = eng.summary(cont, 1.0), eng.summary(resumed, 1.0)
+    for k, v in s1.items():
+        if not k.endswith("_util"):
+            assert s2[k] == v, k
+
+
+# one saved OPEN-SYSTEM checkpoint (arrival plane in the carry) shared
+# by the resume test and every damaged-file test below — the error
+# paths never need their own engine compile
+@pytest.fixture(scope="module")
+def saved(tmp_path_factory):
+    eng = Engine(small_cfg(arrival="poisson", arrival_rate=8.0))
+    st = eng.run(6)
+    path = checkpoint.save(
+        str(tmp_path_factory.mktemp("ckpt") / "ck.npz"), st, cfg=eng.cfg)
+    return eng, st, path
+
+
+def test_arrival_stream_survives_restore(saved):
+    """The open-system arrival plane rides the carry (PRNG key, queue,
+    backlog), so a restored run draws the SAME arrival stream as the
+    uninterrupted one."""
+    eng, st, path = saved
+    rst = checkpoint.restore(path, eng.init_state(), cfg=eng.cfg)
+    assert_states_equal(st, rst)
+    cont = eng.run(6, st)
+    resumed = eng.run(6, rst)
+    assert_states_equal(cont, resumed)
+    s1, s2 = eng.summary(cont), eng.summary(resumed)
+    assert s1["arrival_cnt"] == s2["arrival_cnt"]
+
+
+@pytest.mark.slow
+def test_sharded_round_trip_four_nodes(tmp_path):
+    """The node-stacked ShardState round-trips and resumes bit-exactly
+    on a 4-node sharded cell."""
+    cfg = Config(cc_alg="NO_WAIT", node_cnt=4, part_cnt=4, batch_size=32,
+                 synth_table_size=1 << 12, req_per_query=4,
+                 query_pool_size=1 << 10, zipf_theta=0.6,
+                 tup_read_perc=0.5, warmup_ticks=0, mpr=1.0,
+                 part_per_txn=4)
+    eng = ShardedEngine(cfg)
+    st = eng.run(10)
+    path = checkpoint.save(str(tmp_path / "ck.npz"), st, cfg=cfg)
+    rst = checkpoint.restore(path, eng.init_state(), cfg=cfg)
+    assert_states_equal(st, rst)
+    cont = eng.run(10, st)
+    resumed = eng.run(10, rst)
+    assert_states_equal(cont, resumed)
+
+
+def test_truncated_checkpoint_fails_loudly(saved, tmp_path):
+    eng, _, path = saved
+    bad = str(tmp_path / "trunc.npz")
+    with open(path, "rb") as f:
+        blob = f.read()
+    with open(bad, "wb") as f:
+        f.write(blob[:len(blob) // 2])
+    with pytest.raises(ValueError, match="truncated or corrupt"):
+        checkpoint.restore(bad, eng.init_state(), cfg=eng.cfg)
+
+
+def test_corrupted_leaf_fails_crc(saved, tmp_path):
+    eng, _, path = saved
+    bad = str(tmp_path / "corrupt.npz")
+    shutil.copy(path, bad)
+    # flip one element of one leaf, keep the ORIGINAL metadata: the
+    # stored crc32 must catch the damage
+    with np.load(bad) as z:
+        arrs = {k: np.array(z[k]) for k in z.files}
+    meta = json.loads(bytes(arrs["_meta"]))
+    assert meta["format"] == checkpoint.FORMAT
+    victim = next(k for k in sorted(arrs)
+                  if k.startswith("leaf_") and arrs[k].size > 0)
+    flat = arrs[victim].reshape(-1)
+    flat[0] = ~flat[0] if flat.dtype == np.bool_ else flat[0] + 1
+    np.savez(bad, **arrs)
+    with pytest.raises(ValueError, match="crc32 mismatch"):
+        checkpoint.restore(bad, eng.init_state(), cfg=eng.cfg)
+
+
+def test_wrong_geometry_rejected(saved):
+    _, _, path = saved
+    # a bigger batch changes leaf shapes/counts; init_state alone never
+    # compiles the tick, so the mismatch check costs nothing
+    other = Engine(small_cfg(arrival="poisson", arrival_rate=8.0,
+                             batch_size=64))
+    with pytest.raises(ValueError):
+        checkpoint.restore(path, other.init_state(), cfg=other.cfg)
+
+
+def test_wrong_config_fingerprint_rejected(saved):
+    """Same shapes, different knobs: the config fingerprint catches a
+    checkpoint from a different experiment before a silent wrong
+    resume."""
+    _, _, path = saved
+    other = Engine(small_cfg(arrival="poisson", arrival_rate=8.0,
+                             zipf_theta=0.9))
+    with pytest.raises(ValueError, match="fingerprint"):
+        checkpoint.restore(path, other.init_state(), cfg=other.cfg)

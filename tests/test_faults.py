@@ -1,0 +1,155 @@
+"""Deterministic fault plane + recovery (Config.faults,
+deneva_tpu/faults/): schedule validation, in-tick gating counters, the
+kill-a-node replay-recovery bit-parity contract, and the satellite
+CALVIN exchange-overflow guard."""
+
+import numpy as np
+import pytest
+
+from deneva_tpu import faults as faults_mod
+from deneva_tpu.config import Config
+from deneva_tpu.faults import plan as fault_plan
+from deneva_tpu.parallel.sharded import ShardedEngine
+
+
+def shard_cfg(n=2, **kw):
+    base = dict(node_cnt=n, part_cnt=n, batch_size=32,
+                synth_table_size=1 << 12, req_per_query=4,
+                query_pool_size=1 << 10, zipf_theta=0.6, tup_read_perc=0.5,
+                warmup_ticks=0, mpr=1.0, part_per_txn=n)
+    base.update(kw)
+    return Config(**base)
+
+
+# ---------------------------------------------------------------- plan
+
+
+def test_availability_masks_pure():
+    faults = (("straggle", 1, 3, 8), ("partition", 0, 2, 5, 10),
+              ("kill", 3, 7))
+    # outside every window: all clear
+    dest, me = fault_plan.availability(faults, 0, 0, 4)
+    assert np.asarray(dest).all() and bool(me)
+    # inside the straggle window, every node withholds new work to node 1
+    dest, me = fault_plan.availability(faults, 4, 0, 4)
+    assert not np.asarray(dest)[1] and np.asarray(dest)[[0, 2, 3]].all()
+    assert bool(me)                       # node 0 itself is fine
+    # ... and node 1 itself freezes
+    _, me = fault_plan.availability(faults, 4, 1, 4)
+    assert not bool(me)
+    # the partition cuts 0<->2 symmetrically, leaves 1 and 3 alone
+    # (t=9: the straggle window [3, 8) has closed, only the cut is live)
+    dest0, _ = fault_plan.availability(faults, 9, 0, 4)
+    dest2, _ = fault_plan.availability(faults, 9, 2, 4)
+    dest1, _ = fault_plan.availability(faults, 9, 1, 4)
+    assert not np.asarray(dest0)[2] and not np.asarray(dest2)[0]
+    assert np.asarray(dest1).all()
+    # kills never gate in-tick work (the host driver owns them)
+    dest, me = fault_plan.availability((("kill", 3, 7),), 7, 3, 4)
+    assert np.asarray(dest).all() and bool(me)
+
+
+def test_kill_events_and_window_span():
+    faults = (("straggle", 0, 2, 9), ("kill", 1, 12), ("kill", 0, 4),
+              ("partition", 0, 1, 3, 15))
+    assert fault_plan.kill_events(faults) == [(4, 0), (12, 1)]
+    assert fault_plan.window_span(faults) == 15
+    assert fault_plan.window_span((("kill", 0, 4),)) == 0
+
+
+def test_chaos_plan_deterministic_and_valid():
+    a = fault_plan.chaos_plan(7, n_nodes=4, n_ticks=40, n_events=6)
+    b = fault_plan.chaos_plan(7, n_nodes=4, n_ticks=40, n_events=6)
+    assert a == b                          # replayable by construction
+    assert a != fault_plan.chaos_plan(8, n_nodes=4, n_ticks=40, n_events=6)
+    # every drawn schedule passes Config validation as-is
+    cfg = shard_cfg(4, faults=a)
+    assert cfg.faults == a
+    for spec in a:
+        assert spec[0] in fault_plan.KINDS
+
+
+def test_config_validation_rejects_bad_specs():
+    with pytest.raises(AssertionError):
+        shard_cfg(2, faults=(("flood", 0, 3),))          # unknown kind
+    with pytest.raises(AssertionError):
+        shard_cfg(2, faults=(("straggle", 5, 3, 8),))    # node out of range
+    with pytest.raises(AssertionError):
+        shard_cfg(2, faults=(("straggle", 0, 8, 3),))    # empty window
+    with pytest.raises(AssertionError):
+        shard_cfg(2, faults=(("partition", 1, 1, 3, 8),))  # a == b
+    with pytest.raises(AssertionError):
+        Config(faults=(("kill", 0, 3),))                 # single node
+    with pytest.raises(AssertionError):
+        shard_cfg(2, faults=(("kill", 0, 3),), net_delay_ticks=2)
+
+
+# ------------------------------------------------------- in-tick gating
+
+
+def test_windows_gate_and_kill_recovers_bit_exact():
+    """The acceptance experiment on ONE compiled schedule (straggle +
+    partition windows and a mid-run kill share the 2-node CALVIN tick):
+
+    - the windows freeze new admissions/requests and defer finishing
+      txns — counters account for every gated lane, work is DELAYED
+      never aborted (CALVIN still never aborts), the cluster keeps
+      committing, and the CALVIN epoch log records the admissions;
+    - the killed node recovers by deterministic epoch-log replay, the
+      replayed slice (epoch log included) validates bit-for-bit, and
+      the recovered run's [summary] matches the fault-free oracle on
+      every integer counter."""
+    cfg = shard_cfg(2, cc_alg="CALVIN", fault_elog_cap=64,
+                    faults=(("straggle", 1, 3, 8),
+                            ("partition", 0, 1, 9, 13),
+                            ("kill", 1, 6)))
+    eng = ShardedEngine(cfg)
+    state, counters = faults_mod.run_with_faults(eng, 18)
+    # --- kill recovery: replay crossed the live straggle window too
+    assert counters["fault_kill_cnt"] == 1
+    assert counters["recovery_replay_ok"] == 1    # slice bit-parity
+    assert counters["recovery_elog_ok"] == 1      # epoch-log bit-parity
+    assert counters["recovery_lag_ticks"] == 6    # replayed the prefix
+    # --- oracle: the same jitted tick without the host-side kill (a
+    # kill spec has no in-tick effect, so eng's compiled tick is shared)
+    o = eng.init_state()
+    for _ in range(18):
+        o = eng._jit_tick(o)
+    s_f, s_o = eng.summary(state), eng.summary(o)
+    assert s_f["txn_cnt"] > 0
+    for k, v in s_o.items():
+        if isinstance(v, (int, np.integer)):
+            assert int(s_f[k]) == int(v), k
+    # --- window gating: delay, never abort
+    assert s_f["total_txn_abort_cnt"] == 0
+    # only the straggle window stalls a node's OWN work — the partition
+    # window gates cross-pair requests without freezing either node
+    assert s_f["fault_stall_ticks"] == 5          # the [3, 8) window
+    assert s_f["fault_req_blocked_cnt"] > 0
+    assert eng.global_data_sum(state) == s_f["write_cnt"]
+    # the keep-last epoch log is live on every node
+    lsn = np.asarray(state.stats["fault_elog_lsn"])
+    txn = np.asarray(state.stats["arr_fault_elog_txn"])
+    assert (lsn > 0).all()                 # every node admitted work
+    assert (txn >= 0).any(axis=1).all()    # ... and logged it
+
+
+# ------------------------------------------------ satellite: guard
+
+
+def test_calvin_exchange_guard_names_offenders():
+    """The 2^23 packed-arbitration bound rejects oversized CALVIN cells
+    with a structured ValueError naming (N, B, R) and the epoch_size
+    remedy — not a bare assert."""
+    with pytest.raises(ValueError) as ei:
+        ShardedEngine(Config(
+            cc_alg="CALVIN", node_cnt=2, part_cnt=2, part_per_txn=2,
+            batch_size=1 << 16, req_per_query=128,
+            synth_table_size=1 << 12, query_pool_size=1 << 10,
+            warmup_ticks=0, mpr=1.0))
+    msg = str(ei.value)
+    assert "node_cnt=2" in msg
+    assert "batch_size=65536" in msg
+    assert "max_req=128" in msg
+    assert "epoch_size" in msg
+    assert "2^23" in msg

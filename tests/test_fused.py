@@ -253,6 +253,10 @@ def test_maat_chain_gate_skips_uncontended():
     assert s["maat_chain_overflow_cnt"] == 0
 
 
+# the contended MAAT pair is two chain-gate compiles (~37 s on the
+# tier-1 box) — slow lane, same as the TPC-C MAAT cell above; the
+# uncontended gate + skip test keeps the chain gate tier-1
+@pytest.mark.slow
 def test_maat_chain_gate_contended_parity():
     # contended cell: the chain genuinely engages (counters move) and
     # the fused path reproduces it bit-for-bit
@@ -302,7 +306,11 @@ def test_ineligible_dtype_falls_back():
                for e in fused.fallback_snapshot()["events"])
 
 
-@pytest.mark.parametrize("alg", ["NO_WAIT", "MAAT"])
+@pytest.mark.parametrize("alg", [
+    "NO_WAIT",
+    # the MAAT fused compile alone is ~29 s — slow lane (tier-1 budget)
+    pytest.param("MAAT", marks=pytest.mark.slow),
+])
 def test_fused_zero_post_warm_recompiles(alg):
     eng = Engine(Config(cc_alg=alg, fused_arbitrate=True, xmeter=True,
                         **YCSB_KW))

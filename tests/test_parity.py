@@ -34,8 +34,16 @@ THRESH = {
 # (single source: oracle/parity.py)
 from deneva_tpu.oracle.parity import PARITY_EXTRA as EXTRA  # noqa: E402
 
+# MaaT's per-access validation-range engine is by far the costliest
+# compile in the matrix (~29 s for one parity cell on the tier-1 box);
+# its parity cells ride the slow lane to keep tier-1 inside the 870 s
+# budget — the other six plugins stay tier-1 here, and MAAT keeps its
+# tier-1 correctness coverage in tests/test_maat.py
+_SLOW_MAAT = pytest.param("MAAT", marks=pytest.mark.slow)
 
-@pytest.mark.parametrize("alg", list(THRESH))
+
+@pytest.mark.parametrize("alg", [_SLOW_MAAT if a == "MAAT" else a
+                                 for a in THRESH])
 def test_abort_rate_parity(alg):
     r = run_pair(Config(cc_alg=alg, **EXTRA.get(alg, {}), **CFG),
                  n_ticks=50)
@@ -127,13 +135,6 @@ def test_mvcc_tail_fold_counter_zero_with_sliced_merge():
     s = eng.summary(st)
     assert s["txn_cnt"] > 0
     assert int(np.asarray(st.db["mvcc_tail_fold_cnt"])) == 0
-
-
-# MAAT's access-order chain oracle (round 5) costs ~7x the other
-# plugins per parity cell; the canonical tier-1 MAAT parity guard is
-# test_abort_rate_parity[MAAT] — the workload-variant MAAT cells run
-# with `-m slow` to keep tier-1 inside its 870 s budget.
-_SLOW_MAAT = pytest.param("MAAT", marks=pytest.mark.slow)
 
 
 @pytest.mark.parametrize("alg", ["NO_WAIT", "WAIT_DIE", _SLOW_MAAT, "CALVIN"])

@@ -172,6 +172,41 @@ def test_mesh_keys_round_trip_exactly():
                    for k in p0)
 
 
+def test_fault_keys_round_trip_exactly():
+    """Fault-plane runs (Config.faults, deneva_tpu/faults/) put the
+    in-tick gating counters and the host-side recovery counters on the
+    [summary] line; the stats layer passes them through VERBATIM (counts
+    and 0/1 verdict flags, never time-scaled), they round-trip through
+    the parser port exactly, and the default line carries none."""
+    eng, st = run_engine()
+    s = eng.summary(st)
+    # the passthrough is engine-agnostic: inject the documented key set
+    # (tests/test_faults.py covers the sharded engine producing the
+    # in-tick counters; faults/recovery.py the host-side ones)
+    from deneva_tpu.faults.recovery import HOST_COUNTERS
+    fault = {"fault_req_blocked_cnt": 173, "fault_fin_deferred_cnt": 55,
+             "fault_stall_ticks": 5, "fault_elog_lsn": 139,
+             "fault_kill_cnt": 1, "fault_replay_ticks": 10,
+             "recovery_lag_ticks": 10, "recovery_replay_ok": 1,
+             "recovery_elog_ok": 1, "ckpt_save_cnt": 2,
+             "ckpt_restore_cnt": 1}
+    assert set(HOST_COUNTERS) <= set(fault)
+    d1 = stats_mod.reference_summary({**s, **fault})
+    d2 = stats_mod.reference_summary({**s, **fault},
+                                     wall_seconds=s["measured_ticks"]
+                                     * 2.0)
+    for k, v in fault.items():
+        assert d1[k] == v, k                       # verbatim
+        assert d2[k] == v, k                       # never time-scaled
+    parsed = stats_mod.parse_summary(stats_mod.format_summary(d1))
+    for k, v in fault.items():
+        assert parsed[k] == v, k
+    # the default (fault-off) line carries none of them
+    p0 = stats_mod.parse_summary(eng.summary_line(st, wall_seconds=1.0))
+    assert not any(k.startswith(("fault_", "ckpt_", "recovery_"))
+                   for k in p0)
+
+
 def test_cc_case_counter_families():
     """The per-algorithm families (reference maat_case1/3 + this build's
     chain counters, occ check aborts) ride the [summary] line VERBATIM
