@@ -79,9 +79,24 @@ import time
 if ("--scaling-grid" in sys.argv or "--faults" in sys.argv) and \
         "xla_force_host_platform_device_count" not in \
         os.environ.get("XLA_FLAGS", ""):
+    # size the virtual-device pool from the requested node grid so the
+    # 16/32/64-node scale-out cells are actually runnable (previously a
+    # hard 8 silently clamped them away); 64 host threads is the
+    # practical CPU ceiling, 8 covers the default grid and --faults
+    _dev = 8
+    for _i, _a in enumerate(sys.argv):
+        if _a == "--grid-nodes" and _i + 1 < len(sys.argv):
+            _v = sys.argv[_i + 1]
+        elif _a.startswith("--grid-nodes="):
+            _v = _a.split("=", 1)[1]
+        else:
+            continue
+        _ns = [int(x) for x in _v.split(",") if x.strip().isdigit()]
+        if _ns:
+            _dev = max(_dev, min(max(_ns), 64))
     os.environ["XLA_FLAGS"] = (
         os.environ.get("XLA_FLAGS", "")
-        + " --xla_force_host_platform_device_count=8")
+        + f" --xla_force_host_platform_device_count={_dev}")
 
 import jax
 import numpy as np
@@ -395,9 +410,21 @@ def run_scaling_grid(args, out_dir: str = "results",
         print("[scaling-grid] no runnable node counts")
         return 1
 
+    # scale-out flags (both Config._optin, certifier-proven pure when
+    # off): multi-node cells only — the 1-node anchor has no exchange.
+    # test_mesh.py builds a bare Namespace, hence getattr defaults.
+    rc_on = getattr(args, "grid_remote_cache", False)
+    split_on = getattr(args, "grid_split", False)
+
     def grid_cfg(alg, n, b):
+        extra = {}
+        if n > 1 and rc_on:
+            extra["remote_cache"] = True
+        if n > 1 and split_on:
+            extra["exchange_split"] = True
         return Config(cc_alg=alg, node_cnt=n, part_cnt=n, batch_size=b,
-                      part_per_txn=min(2, n), mesh=True, **GRID_KW)
+                      part_per_txn=min(2, n), mesh=True, **GRID_KW,
+                      **extra)
 
     # two batch shapes from the footprint model: probe the sharded state
     # at B=32 and B=64, fit bytes(B) = fixed + per_txn * B, take the
@@ -467,11 +494,27 @@ def run_scaling_grid(args, out_dir: str = "results",
                     "mesh_drops": s["mesh_drop_cnt"],
                     "watchdog": wd,
                 }
+                # remote-grant stickiness diagnostics (Config.remote_cache):
+                # attempts = entries the exchange WOULD have shipped,
+                # suppressed = attempts answered from the device-resident
+                # grant cache instead of re-shipping
+                if "remote_attempt_cnt" in s:
+                    cell["remote_attempts"] = s["remote_attempt_cnt"]
+                    cell["reship_suppressed"] = s["reship_suppressed_cnt"]
+                    cell["remote_cache_hits"] = s["remote_cache_hit_cnt"]
                 grid[alg].append(cell)
-                cells_hist[f"{alg}@{n}x{b}"] = {
+                # flagged cells key their own trajectory: '+rc'/'+split'
+                # numbers must not shift the baseline medians the
+                # obs/regress.py gate compares against
+                tag = (("+rc" if (n > 1 and rc_on) else "")
+                       + ("+split" if (n > 1 and split_on) else ""))
+                cells_hist[f"{alg}@{n}x{b}{tag}"] = {
                     "commits_per_tick": cell["commits_per_tick"],
-                    "efficiency": cell["efficiency"]}
-                print(f"[scaling-grid] {alg} n={n} B={b}: "
+                    "efficiency": cell["efficiency"],
+                    # remote amplification, gated INVERTED by
+                    # obs/regress.py (growing ratio = regression)
+                    "amplification": cell["remote_ratio"]}
+                print(f"[scaling-grid] {alg} n={n} B={b}{tag}: "
                       f"{cell['commits_per_tick']} commits/tick, "
                       f"speedup {cell['speedup']} "
                       f"(eff {cell['efficiency']}), "
@@ -949,6 +992,16 @@ def _cli():
     p.add_argument("--grid-nodes", default="1,2,4,8",
                    help="comma-separated node counts for --scaling-grid "
                         "(clamped to the device count)")
+    p.add_argument("--grid-remote-cache", action="store_true",
+                   help="run the --scaling-grid cells with "
+                        "Config.remote_cache (remote-grant stickiness) "
+                        "on every multi-node cell; cells key their own "
+                        "'+rc' regression trajectory")
+    p.add_argument("--grid-split", action="store_true",
+                   help="run the --scaling-grid cells with "
+                        "Config.exchange_split (capacity-bounded "
+                        "epoch-split exchange) on every multi-node "
+                        "cell; cells key their own '+split' trajectory")
     p.add_argument("--grid-budget-mb", type=float, default=256.0,
                    help="per-node HBM budget feeding the fit_batch "
                         "model that sizes the large --scaling-grid "

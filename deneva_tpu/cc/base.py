@@ -244,6 +244,18 @@ class CCPlugin:
     #: directional squeeze sees true per-row access order (single-access
     #: virtual txns have ridx 0).
     ship_access_tick: bool = False
+    #: remote-grant stickiness (Config.remote_cache,
+    #: parallel/sharded.py): True for plugins whose access decision for a
+    #: given (row, txn ts) cannot change while the owner's row state is
+    #: unchanged — MAAT's forced grant qualifies; lock-based waits do not
+    #: (a wait can resolve without any row-state write the epoch counter
+    #: would see).  The engine then caches remote grants per txn slot and
+    #: suppresses re-ships while the owner's epoch counter is unmoved.
+    remote_cache_ok: bool = False
+    #: db keys whose per-entry row contribution ``remote_cache_probe``
+    #: returns and the engine caches / replays on a hit (max-merged into
+    #: the home txn's planes with neutral 0, like txn_db_merge "max").
+    remote_cache_fields: tuple[str, ...] = ()
     #: net_delay mode: validation-aborted txns ship their entries through
     #: the commit exchange with commit=0 so owners can clear prepare-phase
     #: reservations (the RFIN(abort) release of a prepared participant,
@@ -298,6 +310,16 @@ class CCPlugin:
     def on_abort(self, cfg: Config, db: dict, txn: TxnState,
                  aborted: jnp.ndarray) -> dict:
         return db
+
+    def remote_cache_probe(self, cfg: Config, db: dict, keys: jnp.ndarray,
+                           iw: jnp.ndarray, live: jnp.ndarray) -> dict:
+        """Owner-side hook (Config.remote_cache): the PURE per-entry row
+        contribution for each ``remote_cache_fields`` key — what this
+        row's CURRENT state adds to the accessing txn's planes, NOT the
+        owner's merged txn view (which would leak a previous attempt's
+        accumulated state into a replay).  Non-live lanes return the
+        merge-neutral 0."""
+        raise NotImplementedError
 
     def on_finalize_entries(self, cfg: Config, db: dict, keys: jnp.ndarray,
                             cts: jnp.ndarray, live: jnp.ndarray) -> dict:

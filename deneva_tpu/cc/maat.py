@@ -68,7 +68,15 @@ against the committer's upper instead of per candidate, so a single
 overshooting reader suppresses the whole jump where the reference would
 still take the smaller candidates; sharded,
 pushes applied at different owners within one tick (or one net-delay
-transit window) become mutually visible only at the next home merge.
+transit window) become mutually visible only at the next home merge;
+remote_cache mode (Config.remote_cache) answers a restarted txn's
+remote accesses from cached row contributions while the owner's epoch
+counter is unmoved — the epoch bumps only on on_commit's lr/lw
+scatters (the only row-state mutation), so a cached entry can miss
+OTHER validators' in-flight squeeze adjustments (upper ducks / lower
+jumps) that a re-ship would have observed; those only ever tighten the
+restarted txn's range later, at validation, trading some extra
+range-collapse risk for the suppressed mesh crossing.
 """
 
 from __future__ import annotations
@@ -98,6 +106,11 @@ class Maat(CCPlugin):
     ship_access_tick = True
     commit_forward_push = True
     forward_push_fields = ("maat_lower", "maat_upper")
+    # access always grants and the decision inputs are pure row state
+    # (lr/lw), mutated only by on_commit — a remote verdict stays valid
+    # while the owner's epoch counter is unmoved (Config.remote_cache)
+    remote_cache_ok = True
+    remote_cache_fields = ("maat_gw", "maat_gr")
     #: MAAT never aborts at access time; every CC abort is a validation
     #: whose [lower, upper) range collapsed empty (maat_range_abort_cnt)
     vabort_reason = "maat_range_collapse"
@@ -180,6 +193,15 @@ class Maat(CCPlugin):
         z = jnp.zeros((B, R), dtype=bool)
         return (AccessDecision(grant=req, wait=z, abort=z),
                 {**db, "maat_gw": gw, "maat_gr": gr})
+
+    def remote_cache_probe(self, cfg: Config, db: dict, keys, iw, live):
+        # the pure per-entry row contribution of access(): lw feeds gw
+        # for every access, lr feeds gr for WRITES only (mirrors the
+        # `valid & riw` gate above).  Merge-neutral 0 off-lane.
+        n_rows = db["maat_lr"].shape[0]
+        kw = jnp.clip(keys, 0, n_rows - 1)
+        return {"maat_gw": jnp.where(live, db["maat_lw"][kw], 0),
+                "maat_gr": jnp.where(live & iw, db["maat_lr"][kw], 0)}
 
     def validate(self, cfg: Config, db: dict, txn: TxnState, finishing, tick,
                  prepared=None):

@@ -453,6 +453,53 @@ class Config:
     #: ``faults`` is non-empty and the plugin admits by epoch.
     fault_elog_cap: int = 1 << 12
 
+    #: capacity-bounded epoch-split exchange (parallel/sharded.py): when
+    #: True, plugins that never abort (CALVIN) stop sizing exchange A for
+    #: the worst case (``cap = B*R`` with the 2^23 packed-sort-index
+    #: ceiling) and instead ship each epoch in trace-time-static
+    #: sub-rounds of at most ``cap`` entries per destination — a
+    #: ``lax.scan`` over sub-rounds inside the tick, reusing the existing
+    #: all_to_all routing sites.  HELD entries still structurally always
+    #: ship (delay-never-drop, the same discipline as the fault gates)
+    #: and owner-side arbitration sees at most ``node_cnt * cap`` virtual
+    #: entries per round, so device memory and the packed sort-index
+    #: width scale with ``cap``, not ``node_cnt * B * R`` — unlocking
+    #: 16–64 virtual nodes at B=8192-scale shapes.  Cross-round grant
+    #: consistency is kept exact by carried per-row owner planes
+    #: (held-first, ts order is preserved by a global stable pre-sort).
+    #: Inert for plugins with an abort path (their exchange is already
+    #: capacity-bounded + drop-tolerant).  Off by default — the
+    #: worst-case single-round path and its [summary] line stay
+    #: byte-identical.
+    exchange_split: bool = _optin(False, {"exchange_split": True},
+                                  engines=("sharded_tick",))
+
+    #: remote-grant stickiness (parallel/sharded.py): when True, plugins
+    #: that opt in (``remote_cache_ok`` — MAAT's forced-grant access)
+    #: carry a device-resident per-txn remote-decision cache: ``(B, R)``
+    #: planes with the last owner verdict + the owner's grant epoch, plus
+    #: per-owner epoch counters bumped on the owner-side release/abort
+    #: sites (on_commit's row-state scatters).  Consulted before the
+    #: exchange-A fan-out: a restarted txn re-ships only entries whose
+    #: owner epoch moved — cache hits answer locally from the cached row
+    #: contribution (``remote_cache_probe``), killing the PR 9 remote
+    #: amplification (8.44 remote attempts per requested access at
+    #: 8n×256).  Hits / suppressed re-ships are counted
+    #: (``remote_cache_hit_cnt`` / ``reship_suppressed_cnt``) and
+    #: reconciled in the mesh observatory.  Off by default — zero extra
+    #: device arrays and a byte-identical [summary] line.
+    remote_cache: bool = _optin(False, {"remote_cache": True},
+                                engines=("sharded_tick",))
+    #: remote-cache invalidation granularity: each owner keeps this many
+    #: per-bucket commit clocks (row -> bucket by local-key modulo) and a
+    #: cached entry stays fresh while its OWN bucket's clock is unmoved —
+    #: a scalar per-owner clock would invalidate the whole node on every
+    #: commit anywhere (useless at steady state), while per-row clocks
+    #: would make the tick-start all_gather scale with the table.  Hash
+    #: collisions only ever invalidate EARLY (false re-ships), never
+    #: late, so the contract is one-sided safe.
+    remote_cache_buckets: int = 256
+
     #: host-side checkpoint cadence for fault/soak drivers
     #: (engine/checkpoint.py, faults/recovery.py): every this-many ticks
     #: the host saves the carry pytree, so a kill can be answered by
@@ -567,6 +614,23 @@ class Config:
                     raise AssertionError(
                         f"unknown fault kind {kind!r} in {spec!r}: "
                         "expected kill | straggle | partition")
+        if self.exchange_split:
+            assert self.node_cnt > 1, \
+                "exchange_split splits the sharded exchange; a single " \
+                "node has no exchange to split"
+            assert self.net_delay_ticks == 0, \
+                "exchange_split composes with the D=0 exchange only: " \
+                "the delay latches track one outstanding round trip " \
+                "per txn, not one per sub-round"
+        if self.remote_cache:
+            assert self.node_cnt > 1, \
+                "remote_cache caches REMOTE owner verdicts; a single " \
+                "node has none"
+            assert self.net_delay_ticks == 0, \
+                "remote_cache composes with the D=0 exchange only: a " \
+                "cache hit answers in-tick, which would reorder " \
+                "against delayed owner responses"
+            assert self.remote_cache_buckets > 0
         assert self.checkpoint_every >= 0
         if self.net_delay_ticks > 0:
             # delay models message transit between shards; a single node

@@ -181,6 +181,48 @@ def note_owner_rx(stats: dict, recv_key, recv_flags, is_epoch, measuring
     return {**stats, "arr_mesh_rx": rx, "arr_mesh_tx": tx}
 
 
+def note_owner_rx_counts(stats: dict, n_live, n_fin, is_epoch, measuring
+                         ) -> dict:
+    """Owner side of the epoch-split exchange A (Config.exchange_split):
+    per-src delivered-lane counts accumulated across sub-rounds inside
+    the lax.scan (the per-round (N, cap) recv planes are ephemeral),
+    folded into the planes once per tick.  Callers pass counts with the
+    self row already zeroed — the self-lane is process-local, not a
+    message.  The decision pass rides the same sub-round windows and is
+    NOT counted as a second request leg (documented non-message, like
+    the MaaT forward-push lanes); its decbits return is the usual
+    one-response-per-delivered-entry mirror."""
+    if "arr_mesh_rx" not in stats:
+        return stats
+    n_live = jnp.where(measuring, n_live, 0)
+    n_fin = jnp.where(measuring, n_fin, 0)
+    rx = stats["arr_mesh_rx"]
+    if is_epoch:
+        rx = rx.at[:, EPOCH].add(n_live)
+    else:
+        rx = rx.at[:, PREP].add(n_fin)
+        rx = rx.at[:, REQ].add(n_live - n_fin)
+    tx = stats["arr_mesh_tx"].at[:, RESP].add(n_live)
+    return {**stats, "arr_mesh_rx": rx, "arr_mesh_tx": tx}
+
+
+def note_commit_exchange_counts(stats: dict, dest, shipped, n_recv,
+                                measuring) -> dict:
+    """Exchange B under the epoch-split exchange (Config.exchange_split):
+    same two ends as note_commit_exchange, but the receive side arrives
+    as per-source counts accumulated across the commit sub-rounds inside
+    the lax.scan.  Callers pass ``n_recv`` with the self row already
+    zeroed — the all_to_all self-lane delivery of local commit entries
+    is process-local, not a message."""
+    if "arr_mesh_tx" not in stats:
+        return stats
+    inc = jnp.where(measuring & shipped, 1, 0).astype(jnp.int32)
+    tx = stats["arr_mesh_tx"].at[dest, COMMIT].add(inc, mode="drop")
+    rx = stats["arr_mesh_rx"].at[:, COMMIT].add(
+        jnp.where(measuring, n_recv, 0).astype(jnp.int32))
+    return {**stats, "arr_mesh_tx": tx, "arr_mesh_rx": rx}
+
+
 def note_commit_exchange(stats: dict, dest, shipped, recv_key, measuring
                          ) -> dict:
     """Exchange B (RFIN): delivered commit-effect entries, both ends.
@@ -327,6 +369,15 @@ def reconcile(snap: dict, summary: dict) -> list:
             if int(attempts[i]) != int(snap["remote"][i]):
                 bad.append((f"remote_entry[{i}]", int(attempts[i]),
                             int(snap["remote"][i])))
+    # remote-grant stickiness (Config.remote_cache): every attempted
+    # remote entry either shipped or was answered from the cache —
+    # attempts == shipped (remote_entry_cnt) + suppressed, cluster-wide
+    if "remote_attempt_cnt" in summary:
+        got = (int(summary["remote_entry_cnt"])
+               + int(summary.get("reship_suppressed_cnt", 0)))
+        want = int(summary["remote_attempt_cnt"])
+        if got != want:
+            bad.append(("remote_cache_attempts", got, want))
     # in-transit population sums to the per-message queue-time integral
     if "inflight" in snap and "lat_msg_queue_time" in summary:
         got = int(snap["inflight"].sum())

@@ -30,7 +30,11 @@ commits_per_tick (a knee collapse = the engine saturates earlier than it
 used to).  Cluster scaling-grid records (bench.py ``--scaling-grid``)
 likewise gate each ``<ALG>@<nodes>x<batch>`` cell's parallel efficiency
 at the same tolerance (an efficiency collapse = the cluster scales worse
-at that point than it used to).
+at that point than it used to), plus the cell's remote ``amplification``
+ratio (remote entries shipped per requested access) with the comparison
+INVERTED — amplification growing past (1 + tol) x median means each
+access ships more mesh traffic than it used to, the exact regression the
+remote-grant stickiness work (Config.remote_cache) exists to prevent.
 
 A gate with no prior data (e.g. per-alg cells first appeared in round 5)
 is SKIPPED with a note, not failed — the gate self-arms as history
@@ -115,6 +119,18 @@ def _entry(source: str, order: tuple, doc: dict) -> Optional[dict]:
         except (TypeError, ValueError):
             continue
     out["scaling_grid"] = grid
+    # the same grid cells carry the remote amplification ratio (remote
+    # entries shipped per requested access) once the scale-out rounds
+    # record it; gated INVERTED (lower is better), self-arming like the
+    # efficiency cells
+    amp = {}
+    for cell_key, cell in (doc.get("scaling_grid") or {}).items():
+        if isinstance(cell, dict) and "amplification" in cell:
+            try:
+                amp[cell_key] = float(cell["amplification"])
+            except (TypeError, ValueError):
+                continue
+    out["scaling_amp"] = amp
     return out
 
 
@@ -205,6 +221,26 @@ def gate(entries: list[dict], current: Optional[dict] = None,
                             f"(median {med:g} over {len(baseline)} "
                             f"prior, tol {tol:g})")
 
+    def check_ceiling(name: str, cur: float, baseline: list[float],
+                      tol: float):
+        """Inverted check for lower-is-better metrics (remote
+        amplification): fail when the current value GROWS past
+        (1 + tol) x median(prior)."""
+        if not baseline:
+            skipped.append(f"{name}: no prior data "
+                           f"(current={cur:g}; gate arms next round)")
+            return
+        med = float(np.median(baseline))
+        ceiling = (1.0 + tol) * med
+        ok = cur <= ceiling
+        checks.append({"name": name, "current": cur, "median": med,
+                       "ceiling": ceiling, "n_prior": len(baseline),
+                       "ok": ok})
+        if not ok:
+            failures.append(f"{name}: {cur:g} > ceiling {ceiling:g} "
+                            f"(median {med:g} over {len(baseline)} "
+                            f"prior, tol {tol:g})")
+
     check(f"headline[{current['metric']}]", current["value"],
           [e["value"] for e in prior if e["metric"] == current["metric"]],
           tolerance)
@@ -246,6 +282,15 @@ def gate(entries: list[dict], current: Optional[dict] = None,
               [e["scaling_grid"][cell_key] for e in prior
                if cell_key in e.get("scaling_grid", {})],
               cpt_tolerance)
+    # remote-amplification trajectory (the same --scaling-grid cells):
+    # INVERTED — the ratio GROWING means every requested access ships
+    # more remote entries over the mesh than it used to (the PR 9
+    # flat-MAAT diagnosis), so the gate is a ceiling, not a floor
+    for cell_key, cur in sorted(current.get("scaling_amp", {}).items()):
+        check_ceiling(f"scaling_grid_amplification[{cell_key}]", cur,
+                      [e["scaling_amp"][cell_key] for e in prior
+                       if cell_key in e.get("scaling_amp", {})],
+                      cpt_tolerance)
     return {"current": current, "checks": checks, "failures": failures,
             "skipped": skipped}
 
@@ -258,9 +303,11 @@ def render_text(result: dict) -> str:
                      f"({cur['metric']}={cur['value']:g}, "
                      f"{len(cur['algs'])} per-alg cells)")
     for c in result["checks"]:
+        bound = (f"floor {c['floor']:g}" if "floor" in c
+                 else f"ceiling {c['ceiling']:g}")
         lines.append(f"  {'OK  ' if c['ok'] else 'FAIL'} {c['name']}: "
                      f"{c['current']:g} vs median {c['median']:g} "
-                     f"(floor {c['floor']:g}, n={c['n_prior']})")
+                     f"({bound}, n={c['n_prior']})")
     # failures without a numeric check row (the required-cell rule)
     for f in result["failures"]:
         if f.startswith("required cell"):

@@ -66,6 +66,66 @@ def pack_by_dest(dest: jnp.ndarray, prio: jnp.ndarray, live: jnp.ndarray,
     return send, orig, overflow
 
 
+def round_plan(dest: jnp.ndarray, heldk: jnp.ndarray, ts: jnp.ndarray,
+               cap: int):
+    """Pre-sort for the capacity-bounded epoch-split exchange
+    (parallel/sharded.py, Config.exchange_split): ONE globally stable
+    (dest, held-first, ts) order drives every sub-round.  All entries of
+    a row share one dest (its owner), so within each dest segment they
+    appear exactly in the (held-first, ts) order the owner's arbitration
+    sorts by (cc/twopl.py) — chopping the segment into contiguous
+    ``cap``-sized windows then distributes each row's entries across
+    sub-rounds order-consistently.
+
+    dest: (n,) destination shard, already ``n_nodes`` for dead lanes.
+    heldk: (n,) 0 for held entries, 1 for requests (held packs first).
+    ts: (n,) entry timestamps.
+
+    Returns (sd, sidx, pos, rnd): sorted dest, the sort permutation,
+    position within the dest segment, and the sub-round (pos // cap)
+    each sorted entry ships in.
+    """
+    n = dest.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    (sd, _, _), (sidx,) = seg.sort_by(
+        (dest.astype(jnp.int32), heldk, ts), (idx,))
+    starts = seg.segment_starts(sd)
+    pos = seg.pos_in_segment(starts)
+    return sd, sidx, pos, pos // cap
+
+
+def pack_round(sd: jnp.ndarray, pos_r: jnp.ndarray, kept: jnp.ndarray,
+               sidx: jnp.ndarray, n_nodes: int, cap: int,
+               fields_s: dict[str, jnp.ndarray]):
+    """Pack one sub-round window of round_plan's pre-sorted entries.
+
+    sd / pos_r / kept / sidx: (n,) sorted dest, position within this
+    round's (dest, cap) window, this-round membership, original entry
+    index.  fields_s: name -> (n,) arrays ALREADY gathered into sort
+    order (``v[sidx]``).
+
+    Returns (send: name -> (N, C), orig: (N, C) original index or -1).
+    No overflow mask: a kept lane has pos_r < cap by construction, so
+    the split exchange structurally never drops an entry — it delays it
+    to a later sub-round instead.
+    """
+    n = sd.shape[0]
+    # kept slots are distinct (pos_r < cap within each dest window);
+    # non-members map to DISTINCT out-of-bounds cells, as in pack_by_dest
+    slot = jnp.where(kept, sd * cap + pos_r,
+                     n_nodes * cap + jnp.arange(n, dtype=jnp.int32))
+    send = {}
+    for name, vals in fields_s.items():
+        fill = FILL.get(name, 0)
+        buf = jnp.full(n_nodes * cap, fill, vals.dtype)
+        send[name] = buf.at[slot].set(vals, mode="drop",
+                                      unique_indices=True).reshape(
+            n_nodes, cap)
+    orig = jnp.full(n_nodes * cap, -1, jnp.int32).at[slot].set(
+        sidx, mode="drop", unique_indices=True).reshape(n_nodes, cap)
+    return send, orig
+
+
 def exchange(send: dict[str, jnp.ndarray], axis_name: str):
     """all_to_all each (N, C) field: row i of the result holds what node i
     sent to me (the batched RQRY delivery)."""

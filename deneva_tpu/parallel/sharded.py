@@ -151,6 +151,22 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
     from deneva_tpu.config import MODE_NOCC, MODE_NORMAL, MODE_SIMPLE
     normal = cfg.mode == MODE_NORMAL
     apply_writes = cfg.mode in (MODE_NORMAL, MODE_NOCC)
+    # trace-time-static feature gates (Config.exchange_split /
+    # Config.remote_cache): the epoch-split exchange applies to plugins
+    # with no abort path (CALVIN — everyone else is already
+    # capacity-bounded and drop-tolerant), the remote-decision cache to
+    # plugins whose access verdict is pure row state (cc/base.py
+    # remote_cache_ok).  Mutually exclusive by trait; each flag is inert
+    # (baseline jaxpr) for plugins outside its trait.
+    split = cfg.exchange_split and plugin.never_aborts
+    rcache = cfg.remote_cache and plugin.remote_cache_ok and normal
+    if split:
+        # the split path computes the deterministic FIFO grant from
+        # per-row aggregates (see exchange A below) — entries carry no
+        # per-txn CC payload to ship round-by-round
+        assert not plugin.txn_db_fields, \
+            "epoch-split exchange supports stateless-entry plugins only"
+    rows_local = workload.cc_rows(cfg) // cfg.part_cnt
     # abort-taxonomy codes (cc/base.py REASON), static per plugin
     vabort_code = jnp.int32(cc_base.REASON[plugin.vabort_reason]
                             if plugin.vabort_reason
@@ -277,6 +293,11 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
                        txn_type=txn_type, targs=targs, aux=aux)
         if normal:
             db = plugin.on_start(cfg, db, txn, free | expire)
+        if rcache:
+            # slot reuse: a freshly admitted txn must not inherit the
+            # previous occupant's cached verdicts; restarted txns keep
+            # theirs — suppressing their re-ship is the whole point
+            db = {**db, "rc_valid": db["rc_valid"] & ~free[:, None]}
 
         # ---- network-delay latches: reset on a fresh attempt ----
         dly = cfg.net_delay_ticks
@@ -433,149 +454,345 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
         for f in plugin.txn_db_fields:
             fields[f] = jnp.broadcast_to(db[f][:, None], (B, R)).reshape(-1)
 
-        # pack held entries first: dropping a held lock entry would hide it
-        # from the owner; a dropped entry aborts its txn instead (a boolean
-        # key, not an additive ts offset — that would overflow int32)
         nE = B * R
-        prio = (~held).astype(jnp.int32)
-        send, orig, overflow = routing.pack_by_dest(
-            dest, prio, live_e & ~local_e, n_nodes, cap, fields)
-        stats = bump(stats, "remote_entry_cnt",
-                     jnp.sum((live_e & ~local_e).astype(jnp.int32)),
-                     measuring)
-        # mesh observatory: delivered + dropped partition the attempted
-        # remote entries exactly, so the tx row reconciles against the
-        # remote_entry_cnt bump above (obs/mesh.py; no-op when off)
-        stats, mesh_per_dest = obs_mesh.note_exchange_a(
-            stats, dest, live_e & ~local_e & ~overflow, overflow,
-            fin2.reshape(-1), plugin.epoch_admission, n_nodes, measuring)
-        stats = obs_mesh.note_occupancy(stats, mesh_per_dest, AXIS,
-                                        measuring)
-
-        recv = routing.exchange(send, AXIS)
-        # rx mirror at the owner: the same delivered lanes, counted at
-        # the receiving end (live == key shipped, fin split via bit 3)
-        stats = obs_mesh.note_owner_rx(stats, recv["key"], recv["flags"],
-                                       plugin.epoch_admission, measuring)
-
-        # ---- 3. owner side: virtual txns -> plugin kernels ----
         # lanes [0, N*cap): received remote entries; [N*cap, N*cap+nE):
         # this node's own local entries, processed in the same kernels
         nR = n_nodes * cap
         Bv = nR + nE
-
-        # Owner-view compaction bucket: the virtual R==1 geometry defeats
-        # the auto live-width formula (it would return identity), yet the
-        # owner lanes are the sparsest view in the system — nR exchange
-        # slots padded for worst-case routing plus nE home lanes, with
-        # live entries ≈ one node's share of global live traffic, i.e.
-        # about the HOME bucket.  Pin the virtual-context compact_lanes
-        # to 2x the home bucket (margin for routing skew); spills force
-        # retries / stall the tick per cc/compact.py, counted in
-        # compact_overflow_cnt — never silent.  request_all plugins
-        # (CALVIN) keep the identity view, as at home.
-        vcfg = cfg
-        if (cfg.entry_compaction and cfg.compact_auto
-                and cfg.compact_lanes is None and not plugin.request_all):
-            home_k = cfg.compact_width(nE, B)
-            if 2 * home_k < Bv:
-                vcfg = cfg.replace(compact_lanes=2 * home_k)
 
         def owner_cat(recv_f, home_f, fill=0):
             loc = jnp.where(local_e, home_f,
                             jnp.asarray(fill, home_f.dtype))
             return jnp.concatenate([recv_f.reshape(-1), loc])
 
-        o_key = owner_cat(recv["key"], jnp.where(local_e, key_l, NULL_KEY),
-                          NULL_KEY)
-        o_flags = owner_cat(recv["flags"], fields["flags"])
-        o_ts = owner_cat(recv["ts"], fields["ts"])
-        o_stick = owner_cat(recv["start_tick"], fields["start_tick"])
-        o_live = o_key != NULL_KEY
-        o_iw = (o_flags & 1) == 1
-        o_held = (o_flags >> 1) & 1 == 1
-        o_fin = ((o_flags >> 3) & 1 == 1) & o_live
-
-        vtxn = TxnState(
-            status=jnp.where(o_live, STATUS_RUNNING, STATUS_FREE),
-            cursor=jnp.where(o_held, 1, 0),
-            ts=o_ts,
-            pool_idx=jnp.zeros(Bv, jnp.int32),
-            restarts=jnp.zeros(Bv, jnp.int32),
-            backoff_until=jnp.zeros(Bv, jnp.int32),
-            start_tick=o_stick,
-            first_start_tick=o_stick,
-            keys=o_key[:, None],
-            is_write=o_iw[:, None],
-            n_req=jnp.where(o_live, 1, 0),
-            txn_type=jnp.zeros(Bv, jnp.int32),
-            targs=jnp.zeros((Bv, 1), jnp.int32),
-            aux=jnp.zeros((Bv, 1), jnp.int32),
-        )
-        vdb = dict(db)
-        for f in plugin.txn_db_fields:
-            vdb[f] = owner_cat(recv[f], fields[f])
-
-        vactive = o_live
-        if normal:
-            dec, vdb = plugin.access(vcfg, vdb, vtxn, vactive)
-            vkw = {}
-            if dly and plugin.commit_forward_push:
-                # validated-but-uncommitted entries (2PC prepare window)
-                # are a distinct class at the owner: VALIDATED in its
-                # TimeTable — they push new validators via cases 2/4/5
-                # and stop being squeeze targets (cc/maat.py)
-                vkw["prepared"] = (((o_flags >> 4) & 1 == 1) & o_live
-                                   & ~o_fin)
-            votes, vdb = plugin.validate(vcfg, vdb, vtxn, o_fin, t, **vkw)
+        if rcache:
+            # ---- remote-grant stickiness: consult the decision cache
+            # BEFORE the fan-out.  Owners publish (K,) per-bucket commit
+            # clocks (bumped at exchange B's on_commit, the only
+            # row-state mutation a remote_cache_ok plugin has); a cached
+            # verdict is fresh while its row's bucket clock has not
+            # moved since it was learned.  The tick-start gather
+            # reflects commits through the END of tick t-1 — exactly
+            # the row state this tick's exchange A arbitrates against.
+            K = cfg.remote_cache_buckets
+            epochs = jax.lax.all_gather(db["rc_owner_epoch"], AXIS)
+            owner_e = (key_g % n_parts).astype(jnp.int32)
+            cur_ep = epochs[owner_e, key_l % K]
+            cached = db["rc_valid"].reshape(-1) & live_e & ~local_e
+            fresh_c = cur_ep == db["rc_epoch"].reshape(-1)
+            # a stale line invalidates now and re-learns from the
+            # re-shipped entry's response below
+            db = {**db, "rc_valid": (db["rc_valid"].reshape(-1)
+                                     & ~(cached & ~fresh_c)).reshape(B, R)}
+            # suppressed re-ships: fresh-cached entries of txns NOT
+            # finishing this tick (validation votes always ship — the
+            # owner must see the full footprint to vote).  Requested
+            # lanes among them are answered from the cache at home.
+            suppress = cached & fresh_c & ~fin2.reshape(-1)
+            hit_req = suppress & req
+            ship = live_e & ~local_e & ~suppress
+            stats = bump(stats, "remote_attempt_cnt",
+                         jnp.sum((live_e & ~local_e).astype(jnp.int32)),
+                         measuring)
+            stats = bump(stats, "remote_cache_hit_cnt",
+                         jnp.sum(hit_req.astype(jnp.int32)), measuring)
+            stats = bump(stats, "reship_suppressed_cnt",
+                         jnp.sum(suppress.astype(jnp.int32)), measuring)
         else:
-            # NOCC ladder: every request grants at its owner, every vote
-            # is yes (row.cpp:199-206)
-            from deneva_tpu.cc.base import AccessDecision
-            o_req = (((o_flags >> 2) & 1) == 1) & o_live
-            z = jnp.zeros((Bv, 1), dtype=bool)
-            dec = AccessDecision(grant=o_req[:, None], wait=z, abort=z)
-            votes = o_fin
-        if dly and plugin.release_on_vabort:
-            # refresh prepare marks of yes-voted txns still awaiting their
-            # delayed/deferred commit, so expiry only ever reaps marks
-            # whose release was genuinely lost
-            o_prep = (((o_flags >> 4) & 1) == 1) & o_live
-            vdb = plugin.on_prepared_entries(cfg, vdb, o_key, o_ts,
-                                             o_prep, t)
+            ship = live_e & ~local_e
 
-        decbits = (dec.grant.reshape(-1).astype(jnp.int32)
-                   | (dec.wait.reshape(-1).astype(jnp.int32) << 1)
-                   | (dec.abort.reshape(-1).astype(jnp.int32) << 2)
-                   | (votes.astype(jnp.int32) << 3))
-        # lint: disable-next=TRACED-BRANCH is-None STRUCTURE check: reason is None iff the plugin carries no access codes (static per plugin+config), never a traced-value branch
-        if cfg.abort_attribution and dec.reason is not None:
-            # the owner's abort reason rides the decision word home in
-            # bits 4..7 (cc/base.py keeps len(ABORT_REASONS) < 16 —
-            # asserted there), masked to actual abort lanes
-            decbits = decbits | (jnp.where(dec.abort.reshape(-1),
-                                           dec.reason.reshape(-1), 0) << 4)
-        back = {"decbits": decbits[:nR].reshape(n_nodes, cap)}
-        for f in plugin.txn_db_fields:
-            back[f] = vdb[f][:nR].reshape(n_nodes, cap)
-        decb_loc = decbits[nR:]
-        vdb_loc = {f: vdb[f][nR:] for f in plugin.txn_db_fields}
-        # keep owner-updated ROW arrays; txn-keyed fields travel back instead
-        db = {**db, **{k: v for k, v in vdb.items()
-                       if k not in plugin.txn_db_fields}}
+        stats = bump(stats, "remote_entry_cnt",
+                     jnp.sum(ship.astype(jnp.int32)), measuring)
 
-        ret = routing.exchange(back, AXIS)
+        if split:
+            # ---- capacity-bounded epoch-split exchange ----
+            # Every live entry (local ones ride the self-lane) ships in
+            # one of S trace-time-static sub-rounds of at most ``cap``
+            # entries per destination: overflow is structurally
+            # impossible — load DELAYS to a later sub-round, it never
+            # drops.  The owner never materializes the epoch: CALVIN's
+            # deterministic FIFO verdict — a write grants at the row
+            # head, a read grants iff no live write precedes it in
+            # (held-first, ts) order (cc/twopl.py arbitrate) — is
+            # decomposable into four per-row aggregates, accumulated
+            # with scatter-min/max as sub-rounds arrive (pass 1); each
+            # entry's decision is then read off the completed planes and
+            # returned through the inverse exchange (pass 2, riding the
+            # same windows).  Bit-equal to the single-round exchange
+            # except for (held-kind, ts) ties, which only a txn's own
+            # duplicate-key entries can produce (timestamps are globally
+            # unique per txn).
+            dest_s = jnp.where(live_e, key_g % n_parts, n_nodes)
+            heldk = (~held).astype(jnp.int32)
+            sd_s, idx_s, pos_s, rnd_s = routing.round_plan(
+                dest_s, heldk, ts_e, cap)
+            S = -(-nE // cap)
+            fields_s = {k: fields[k][idx_s]
+                        for k in ("key", "ts", "flags")}
+            notself = jnp.arange(n_nodes, dtype=jnp.int32) != node_id
 
-        # ---- 4. home: unpack decisions, advance, vote-gather ----
-        defaults = {"decbits": jnp.zeros(nE + 1, jnp.int32).at[:].set(
-            jnp.int32(1 << 3))}  # unshipped: no decision, vote=yes
-        for f in plugin.txn_db_fields:
-            defaults[f] = jnp.concatenate(
-                [jnp.broadcast_to(db[f][:, None], (B, R)).reshape(-1),
-                 jnp.zeros(1, db[f].dtype)])
-        got = routing.unpack(ret, orig, nE, defaults)
-        decb = jnp.where(local_e, decb_loc,
-                         got["decbits"][:nE]).reshape(B, R)
+            def ship_round(r):
+                kept_r = (sd_s < n_nodes) & (rnd_s == r)
+                return routing.pack_round(sd_s, pos_s - r * cap, kept_r,
+                                          idx_s, n_nodes, cap, fields_s)
+
+            def pass1(carry, r):
+                (row_held, row_held_w, row_rmin, row_rwmin,
+                 rx_live, rx_fin) = carry
+                send_r, _ = ship_round(r)
+                recv_r = routing.exchange(send_r, AXIS)
+                o_key = recv_r["key"].reshape(-1)
+                o_live = o_key != NULL_KEY
+                o_flags = recv_r["flags"].reshape(-1)
+                o_ts = recv_r["ts"].reshape(-1)
+                o_iw = (o_flags & 1) == 1
+                o_held = ((o_flags >> 1) & 1) == 1
+                o_req = (((o_flags >> 2) & 1) == 1) & o_live
+                tgt = lambda m: jnp.where(m, o_key, rows_local)
+                one = jnp.int32(1)
+                row_held = row_held.at[tgt(o_live & o_held)].max(
+                    one, mode="drop")
+                row_held_w = row_held_w.at[
+                    tgt(o_live & o_held & o_iw)].max(one, mode="drop")
+                row_rmin = row_rmin.at[tgt(o_req)].min(o_ts, mode="drop")
+                row_rwmin = row_rwmin.at[tgt(o_req & o_iw)].min(
+                    o_ts, mode="drop")
+                # mesh rx fold: delivered lanes per source, the self row
+                # excluded (the self-lane is process-local, no message)
+                rlive = recv_r["key"] != NULL_KEY
+                rfin = rlive & (((recv_r["flags"] >> 3) & 1) == 1)
+                rx_live = rx_live + jnp.where(
+                    notself, rlive.sum(axis=1).astype(jnp.int32), 0)
+                rx_fin = rx_fin + jnp.where(
+                    notself, rfin.sum(axis=1).astype(jnp.int32), 0)
+                return (row_held, row_held_w, row_rmin, row_rwmin,
+                        rx_live, rx_fin), None
+
+            (row_held, row_held_w, row_rmin, row_rwmin,
+             rx_live, rx_fin), _ = jax.lax.scan(
+                pass1,
+                (jnp.zeros(rows_local, jnp.int32),
+                 jnp.zeros(rows_local, jnp.int32),
+                 jnp.full(rows_local, BIG_TS, jnp.int32),
+                 jnp.full(rows_local, BIG_TS, jnp.int32),
+                 jnp.zeros(n_nodes, jnp.int32),
+                 jnp.zeros(n_nodes, jnp.int32)),
+                jnp.arange(S, dtype=jnp.int32))
+
+            def pass2(acc_c, r):
+                send_r, orig_r = ship_round(r)
+                recv_r = routing.exchange(send_r, AXIS)
+                o_key = recv_r["key"].reshape(-1)
+                o_live = o_key != NULL_KEY
+                o_flags = recv_r["flags"].reshape(-1)
+                o_ts = recv_r["ts"].reshape(-1)
+                o_iw = (o_flags & 1) == 1
+                o_req = (((o_flags >> 2) & 1) == 1) & o_live
+                kc = jnp.clip(o_key, 0, rows_local - 1)
+                if normal:
+                    g = o_req & jnp.where(
+                        o_iw,
+                        (row_held[kc] == 0) & (o_ts <= row_rmin[kc]),
+                        (row_held_w[kc] == 0) & (o_ts <= row_rwmin[kc]))
+                else:
+                    # NOCC ladder: every request grants at its owner
+                    g = o_req
+                decbits_r = (g.astype(jnp.int32)
+                             | ((o_req & ~g).astype(jnp.int32) << 1)
+                             | (jnp.int32(1) << 3))
+                ret_r = routing.exchange(
+                    {"decbits": decbits_r.reshape(n_nodes, cap)}, AXIS)
+                # each lane belongs to exactly one sub-round; the others
+                # leave its accumulator cell untouched
+                acc_c = routing.unpack(ret_r, orig_r, nE,
+                                       {"decbits": acc_c})["decbits"]
+                return acc_c, None
+
+            acc, _ = jax.lax.scan(
+                pass2, jnp.full(nE + 1, 1 << 3, dtype=jnp.int32),
+                jnp.arange(S, dtype=jnp.int32))
+            decb = acc[:nE].reshape(B, R)
+            overflow = jnp.zeros(nE, dtype=bool)
+            # mesh observatory: one logical request delivery per shipped
+            # entry (the decision pass rides the same windows and is not
+            # a second message); nothing drops on the split path
+            stats, mesh_per_dest = obs_mesh.note_exchange_a(
+                stats, dest, ship, jnp.zeros_like(ship),
+                fin2.reshape(-1), plugin.epoch_admission, n_nodes,
+                measuring)
+            stats = obs_mesh.note_occupancy(stats, mesh_per_dest, AXIS,
+                                            measuring)
+            stats = obs_mesh.note_owner_rx_counts(
+                stats, rx_live, rx_fin, plugin.epoch_admission, measuring)
+            stats = bump(stats, "exchange_round_cnt",
+                         jnp.max(jnp.where(sd_s < n_nodes, rnd_s + 1, 0)),
+                         measuring)
+        else:
+            # pack held entries first: dropping a held lock entry would
+            # hide it from the owner; a dropped entry aborts its txn
+            # instead (a boolean key, not an additive ts offset — that
+            # would overflow int32)
+            prio = (~held).astype(jnp.int32)
+            send, orig, overflow = routing.pack_by_dest(
+                dest, prio, ship, n_nodes, cap, fields)
+            # mesh observatory: delivered + dropped partition the
+            # attempted remote entries exactly, so the tx row reconciles
+            # against the remote_entry_cnt bump above (obs/mesh.py;
+            # no-op when off)
+            stats, mesh_per_dest = obs_mesh.note_exchange_a(
+                stats, dest, ship & ~overflow, overflow,
+                fin2.reshape(-1), plugin.epoch_admission, n_nodes,
+                measuring)
+            stats = obs_mesh.note_occupancy(stats, mesh_per_dest, AXIS,
+                                            measuring)
+
+            recv = routing.exchange(send, AXIS)
+            # rx mirror at the owner: the same delivered lanes, counted
+            # at the receiving end (live == key shipped, fin via bit 3)
+            stats = obs_mesh.note_owner_rx(stats, recv["key"],
+                                           recv["flags"],
+                                           plugin.epoch_admission,
+                                           measuring)
+
+            # ---- 3. owner side: virtual txns -> plugin kernels ----
+            # Owner-view compaction bucket: the virtual R==1 geometry
+            # defeats the auto live-width formula (it would return
+            # identity), yet the owner lanes are the sparsest view in
+            # the system — nR exchange slots padded for worst-case
+            # routing plus nE home lanes, with live entries ≈ one node's
+            # share of global live traffic, i.e. about the HOME bucket.
+            # Pin the virtual-context compact_lanes to 2x the home
+            # bucket (margin for routing skew); spills force retries /
+            # stall the tick per cc/compact.py, counted in
+            # compact_overflow_cnt — never silent.  request_all plugins
+            # (CALVIN) keep the identity view, as at home.
+            vcfg = cfg
+            if (cfg.entry_compaction and cfg.compact_auto
+                    and cfg.compact_lanes is None
+                    and not plugin.request_all):
+                home_k = cfg.compact_width(nE, B)
+                if 2 * home_k < Bv:
+                    vcfg = cfg.replace(compact_lanes=2 * home_k)
+
+            o_key = owner_cat(recv["key"],
+                              jnp.where(local_e, key_l, NULL_KEY),
+                              NULL_KEY)
+            o_flags = owner_cat(recv["flags"], fields["flags"])
+            o_ts = owner_cat(recv["ts"], fields["ts"])
+            o_stick = owner_cat(recv["start_tick"], fields["start_tick"])
+            o_live = o_key != NULL_KEY
+            o_iw = (o_flags & 1) == 1
+            o_held = (o_flags >> 1) & 1 == 1
+            o_fin = ((o_flags >> 3) & 1 == 1) & o_live
+
+            vtxn = TxnState(
+                status=jnp.where(o_live, STATUS_RUNNING, STATUS_FREE),
+                cursor=jnp.where(o_held, 1, 0),
+                ts=o_ts,
+                pool_idx=jnp.zeros(Bv, jnp.int32),
+                restarts=jnp.zeros(Bv, jnp.int32),
+                backoff_until=jnp.zeros(Bv, jnp.int32),
+                start_tick=o_stick,
+                first_start_tick=o_stick,
+                keys=o_key[:, None],
+                is_write=o_iw[:, None],
+                n_req=jnp.where(o_live, 1, 0),
+                txn_type=jnp.zeros(Bv, jnp.int32),
+                targs=jnp.zeros((Bv, 1), jnp.int32),
+                aux=jnp.zeros((Bv, 1), jnp.int32),
+            )
+            vdb = dict(db)
+            for f in plugin.txn_db_fields:
+                vdb[f] = owner_cat(recv[f], fields[f])
+
+            vactive = o_live
+            if normal:
+                dec, vdb = plugin.access(vcfg, vdb, vtxn, vactive)
+                vkw = {}
+                if dly and plugin.commit_forward_push:
+                    # validated-but-uncommitted entries (2PC prepare
+                    # window) are a distinct class at the owner:
+                    # VALIDATED in its TimeTable — they push new
+                    # validators via cases 2/4/5 and stop being squeeze
+                    # targets (cc/maat.py)
+                    vkw["prepared"] = (((o_flags >> 4) & 1 == 1) & o_live
+                                       & ~o_fin)
+                votes, vdb = plugin.validate(vcfg, vdb, vtxn, o_fin, t,
+                                             **vkw)
+            else:
+                # NOCC ladder: every request grants at its owner, every
+                # vote is yes (row.cpp:199-206)
+                from deneva_tpu.cc.base import AccessDecision
+                o_req = (((o_flags >> 2) & 1) == 1) & o_live
+                z = jnp.zeros((Bv, 1), dtype=bool)
+                dec = AccessDecision(grant=o_req[:, None], wait=z,
+                                     abort=z)
+                votes = o_fin
+            if dly and plugin.release_on_vabort:
+                # refresh prepare marks of yes-voted txns still awaiting
+                # their delayed/deferred commit, so expiry only ever
+                # reaps marks whose release was genuinely lost
+                o_prep = (((o_flags >> 4) & 1) == 1) & o_live
+                vdb = plugin.on_prepared_entries(cfg, vdb, o_key, o_ts,
+                                                 o_prep, t)
+            if rcache:
+                # owner-side cache payload: the PURE per-entry row
+                # contribution (cc/base.py remote_cache_probe — NOT the
+                # merged txn view, which would leak a previous attempt's
+                # accumulated state into a replay)
+                rcp = plugin.remote_cache_probe(cfg, vdb, o_key, o_iw,
+                                                o_live)
+
+            decbits = (dec.grant.reshape(-1).astype(jnp.int32)
+                       | (dec.wait.reshape(-1).astype(jnp.int32) << 1)
+                       | (dec.abort.reshape(-1).astype(jnp.int32) << 2)
+                       | (votes.astype(jnp.int32) << 3))
+            # lint: disable-next=TRACED-BRANCH is-None STRUCTURE check: reason is None iff the plugin carries no access codes (static per plugin+config), never a traced-value branch
+            if cfg.abort_attribution and dec.reason is not None:
+                # the owner's abort reason rides the decision word home
+                # in bits 4..7 (cc/base.py keeps len(ABORT_REASONS) < 16
+                # — asserted there), masked to actual abort lanes
+                decbits = decbits | (jnp.where(dec.abort.reshape(-1),
+                                               dec.reason.reshape(-1), 0)
+                                     << 4)
+            back = {"decbits": decbits[:nR].reshape(n_nodes, cap)}
+            for f in plugin.txn_db_fields:
+                back[f] = vdb[f][:nR].reshape(n_nodes, cap)
+            if rcache:
+                for f in plugin.remote_cache_fields:
+                    back["rcp_" + f] = rcp[f][:nR].reshape(n_nodes, cap)
+            decb_loc = decbits[nR:]
+            vdb_loc = {f: vdb[f][nR:] for f in plugin.txn_db_fields}
+            # keep owner-updated ROW arrays; txn-keyed fields travel
+            # back instead
+            db = {**db, **{k: v for k, v in vdb.items()
+                           if k not in plugin.txn_db_fields}}
+
+            ret = routing.exchange(back, AXIS)
+
+            # ---- 4. home: unpack decisions, advance, vote-gather ----
+            defaults = {"decbits": jnp.zeros(nE + 1, jnp.int32).at[:].set(
+                jnp.int32(1 << 3))}  # unshipped: no decision, vote=yes
+            for f in plugin.txn_db_fields:
+                defaults[f] = jnp.concatenate(
+                    [jnp.broadcast_to(db[f][:, None], (B, R)).reshape(-1),
+                     jnp.zeros(1, db[f].dtype)])
+            if rcache:
+                for f in plugin.remote_cache_fields:
+                    defaults["rcp_" + f] = jnp.zeros(nE + 1, jnp.int32)
+            got = routing.unpack(ret, orig, nE, defaults)
+            decb = jnp.where(local_e, decb_loc,
+                             got["decbits"][:nE]).reshape(B, R)
+            if rcache:
+                # cache-hit requests grant at home, replaying the cached
+                # row contribution into the txn's planes (max-merge with
+                # neutral 0 — the txn_db_merge discipline)
+                hitBR = hit_req.reshape(B, R)
+                decb = decb | jnp.where(hitBR, 1, 0)
+                for f in plugin.remote_cache_fields:
+                    db = {**db, f: jnp.maximum(
+                        db[f], jnp.where(hitBR, db["rc_" + f],
+                                         0).max(axis=1))}
         grant = (decb & 1) == 1
         wait_e = ((decb >> 1) & 1) == 1
         abort_e = ((decb >> 2) & 1) == 1
@@ -591,6 +808,23 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
                 & (t >= net["grant_tick"] + delay_e)
         else:
             grant_vis = grant
+
+        if rcache:
+            # learn / refresh: granted shipped requests fill the cache;
+            # shipped held entries (granted in an earlier tick) refresh
+            # their contribution + epoch so they stop re-shipping.
+            # Overflowed lanes got defaults, not owner state — excluded.
+            shipBR = (ship & ~overflow).reshape(B, R)
+            learn = ((grant & req.reshape(B, R))
+                     | held.reshape(B, R)) & shipBR
+            db = {**db,
+                  "rc_valid": db["rc_valid"] | learn,
+                  "rc_epoch": jnp.where(learn, cur_ep.reshape(B, R),
+                                        db["rc_epoch"]),
+                  **{"rc_" + f: jnp.where(
+                      learn, got["rcp_" + f][:nE].reshape(B, R),
+                      db["rc_" + f])
+                     for f in plugin.remote_cache_fields}}
 
         per_entry_db = {}
         for f in plugin.txn_db_fields:
@@ -768,141 +1002,246 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
             fieldsB["fts"] = ts_e
             fieldsB["loclo"] = per_entry_db[
                 plugin.commit_ts_field].reshape(-1)
-        sendB, origB, ovfB = routing.pack_by_dest(
-            dest, ts_e, commit_e & ~local_e, n_nodes, cap, fieldsB)
-        ovfB_txn = jnp.any(ovfB.reshape(B, R), axis=1)
-        commit = commit_try & ~ovfB_txn          # deferred txns retry RFIN
-        stats = bump(stats, "commit_defer_cnt",
-                     jnp.sum((ovfB_txn & commit_try).astype(jnp.int32)),
-                     measuring)
-        # re-gather the final commit flag so deferred txns' shipped entries
-        # are ignored by the owner (no repack needed)
-        cflag_flat = jnp.concatenate(
-            [(commit[:, None] & (ridx < txn.n_req[:, None])).reshape(-1),
-             jnp.zeros(1, bool)])
-        oB = origB.reshape(-1)
-        sendB["commit"] = cflag_flat[jnp.where(oB >= 0, oB, nE)].astype(
-            jnp.int32).reshape(n_nodes, cap)
-        if dly and plugin.release_on_vabort:
-            # final-disposition flag: 1 for entries of txns that COMMIT or
-            # RELEASE this tick; 0 for RFIN-deferred commits, whose prepare
-            # marks must survive the deferral window
-            final_txn = commit | vabort_apply
-            fflag_flat = jnp.concatenate(
-                [(final_txn[:, None]
-                  & (ridx < txn.n_req[:, None])).reshape(-1),
+        if split:
+            # capacity-bounded commit sub-rounds: a never_aborts plugin
+            # commits exactly what it tried, and the split exchange ships
+            # the RFIN entries in as many cap-sized windows as needed
+            # (delay-never-drop, like exchange A) — no commit is ever
+            # deferred and the B*R worst-case buffer disappears from the
+            # apply phase too.  Local entries ride the process-local
+            # self-lane of the all_to_all so owners see remote + local
+            # commits through ONE per-round code path.
+            commit = commit_try
+            dest_b = jnp.where(commit_e, key_g % n_parts, n_nodes)
+            sdB, idxB, posB, rndB = routing.round_plan(
+                dest_b, jnp.zeros(nE, jnp.int32), cts_e, cap)
+            SB = -(-nE // cap)
+            if workload.has_effects:
+                flds = workload.commit_fields(cfg, tables, txn, commit)
+                for f in workload.effect_fields:
+                    fieldsB[f] = flds[f].reshape(-1)
+            fieldsB_s = {k: v[idxB] for k, v in fieldsB.items()}
+            keptB = sdB < n_nodes
+
+            def passB(carry, r):
+                db_c, data_c, tables_c, rxB = carry
+                sendB, _ = routing.pack_round(
+                    sdB, posB - r * cap, keptB & (rndB == r), idxB,
+                    n_nodes, cap, fieldsB_s)
+                recvB = routing.exchange(sendB, AXIS)
+                rB_key = recvB["key"].reshape(-1)
+                rB_commit = rB_key != NULL_KEY
+                rB_iw = recvB["iw"].reshape(-1) == 1
+                rB_cts = recvB["cts"].reshape(-1)
+                if normal:
+                    vtxnB = TxnState(
+                        status=jnp.where(rB_commit, STATUS_RUNNING,
+                                         STATUS_FREE),
+                        cursor=jnp.ones(nR, jnp.int32),
+                        ts=rB_cts,
+                        pool_idx=jnp.zeros(nR, jnp.int32),
+                        restarts=jnp.zeros(nR, jnp.int32),
+                        backoff_until=jnp.zeros(nR, jnp.int32),
+                        start_tick=jnp.zeros(nR, jnp.int32),
+                        first_start_tick=jnp.zeros(nR, jnp.int32),
+                        keys=rB_key[:, None],
+                        is_write=rB_iw[:, None],
+                        n_req=jnp.where(rB_commit, 1, 0),
+                        txn_type=jnp.zeros(nR, jnp.int32),
+                        targs=jnp.zeros((nR, 1), jnp.int32),
+                        aux=jnp.zeros((nR, 1), jnp.int32),
+                    )
+                    vdbB = dict(db_c)
+                    if plugin.commit_ts_field:
+                        vdbB[plugin.commit_ts_field] = rB_cts
+                    vdbB = plugin.on_commit(cfg, vdbB, vtxnB, rB_commit,
+                                            commit_ts=rB_cts, tick=t)
+                    db_c = {**db_c,
+                            **{k: v for k, v in vdbB.items()
+                               if k not in plugin.txn_db_fields
+                               and k != plugin.commit_ts_field}}
+                if apply_writes:
+                    data_c = data_c.at[
+                        jnp.where(rB_commit & rB_iw, rB_key,
+                                  NULL_KEY)].add(1, mode="drop")
+                if workload.has_effects and apply_writes:
+                    tables_c = workload.apply_commit_entries(
+                        cfg, tables_c, rB_key, node_id,
+                        {f: recvB[f].reshape(-1)
+                         for f in workload.effect_fields},
+                        rB_cts, rB_commit)
+                rxB = rxB + jnp.where(
+                    notself,
+                    jnp.sum(rB_commit.reshape(n_nodes, cap).astype(
+                        jnp.int32), axis=1), 0)
+                return (db_c, data_c, tables_c, rxB), jnp.int32(0)
+
+            # Trace-time unroll, NOT lax.scan/fori_loop: when the commit
+            # sub-rounds lower to an XLA `while`, the SPMD partitioner
+            # mis-shards the shard-LOCAL round_plan sort that feeds the
+            # loop — it inserts cross-partition sum all-reduces over the
+            # sort inputs (observed as `all-reduce(..., to_apply=add)` ops
+            # attributed to ops/segment.py's lax.sort in the optimized
+            # HLO, absent before optimization), garbling every entry's
+            # destination/position/round and silently corrupting the data
+            # plane.  The unrolled form keeps every op manually sharded
+            # and is bit-identical to the single-round exchange; SB =
+            # ceil(nE / cap) stays small (<= part_cnt/rcf, <= 64 at 64
+            # nodes) so program size is bounded.
+            carryB = (db, data, tables, jnp.zeros(n_nodes, jnp.int32))
+            for _r in range(SB):
+                carryB, _ = passB(carryB, jnp.int32(_r))
+            db, data, tables, rxB_cnt = carryB
+            stats = obs_mesh.note_commit_exchange_counts(
+                stats, dest, commit_e & ~local_e, rxB_cnt, measuring)
+        else:
+            sendB, origB, ovfB = routing.pack_by_dest(
+                dest, ts_e, commit_e & ~local_e, n_nodes, cap, fieldsB)
+            ovfB_txn = jnp.any(ovfB.reshape(B, R), axis=1)
+            commit = commit_try & ~ovfB_txn          # deferred txns retry RFIN
+            stats = bump(stats, "commit_defer_cnt",
+                         jnp.sum((ovfB_txn & commit_try).astype(jnp.int32)),
+                         measuring)
+            # re-gather the final commit flag so deferred txns' shipped entries
+            # are ignored by the owner (no repack needed)
+            cflag_flat = jnp.concatenate(
+                [(commit[:, None] & (ridx < txn.n_req[:, None])).reshape(-1),
                  jnp.zeros(1, bool)])
-            sendB["final"] = fflag_flat[jnp.where(oB >= 0, oB, nE)].astype(
+            oB = origB.reshape(-1)
+            sendB["commit"] = cflag_flat[jnp.where(oB >= 0, oB, nE)].astype(
                 jnp.int32).reshape(n_nodes, cap)
-        if workload.has_effects:
-            # per-entry effect args (the RFIN payload carrying the
-            # workload's state-machine results to the row owners); computed
-            # on the FINAL commit mask so e.g. TPC-C o_id assignment skips
-            # deferred txns, and gathered through the pack permutation
-            flds = workload.commit_fields(cfg, tables, txn, commit)
-            for f in workload.effect_fields:
-                vflat = jnp.concatenate(
-                    [flds[f].reshape(-1), jnp.zeros(1, flds[f].dtype)])
-                sendB[f] = vflat[jnp.where(oB >= 0, oB, nE)].reshape(
-                    n_nodes, cap)
+            if dly and plugin.release_on_vabort:
+                # final-disposition flag: 1 for entries of txns that COMMIT or
+                # RELEASE this tick; 0 for RFIN-deferred commits, whose prepare
+                # marks must survive the deferral window
+                final_txn = commit | vabort_apply
+                fflag_flat = jnp.concatenate(
+                    [(final_txn[:, None]
+                      & (ridx < txn.n_req[:, None])).reshape(-1),
+                     jnp.zeros(1, bool)])
+                sendB["final"] = fflag_flat[jnp.where(oB >= 0, oB, nE)].astype(
+                    jnp.int32).reshape(n_nodes, cap)
+            if workload.has_effects:
+                # per-entry effect args (the RFIN payload carrying the
+                # workload's state-machine results to the row owners); computed
+                # on the FINAL commit mask so e.g. TPC-C o_id assignment skips
+                # deferred txns, and gathered through the pack permutation
+                flds = workload.commit_fields(cfg, tables, txn, commit)
+                for f in workload.effect_fields:
+                    vflat = jnp.concatenate(
+                        [flds[f].reshape(-1), jnp.zeros(1, flds[f].dtype)])
+                    sendB[f] = vflat[jnp.where(oB >= 0, oB, nE)].reshape(
+                        n_nodes, cap)
 
-        recvB = routing.exchange(sendB, AXIS)
-        # mesh: delivered commit-effect entries at both ends (a deferred
-        # txn's packed entries DID travel; the owner drops them via the
-        # commit flag, not the wire)
-        stats = obs_mesh.note_commit_exchange(
-            stats, dest, commit_e & ~local_e & ~ovfB, recvB["key"],
-            measuring)
-        # owner view = received remote commit entries + my own local ones
-        # (local lanes use the FINAL commit/final masks directly — no
-        # re-gather needed, they never packed)
-        cfin_loc = cflag_flat[:nE] & local_e
-        rB_key = owner_cat(recvB["key"],
-                           jnp.where(commit_e & local_e, key_l, NULL_KEY),
-                           NULL_KEY)
-        rB_commit = jnp.concatenate(
-            [(recvB["commit"].reshape(-1) == 1)
-             & (recvB["key"].reshape(-1) != NULL_KEY),
-             cfin_loc])
-        rB_iw = owner_cat(recvB["iw"],
-                          txn.is_write.reshape(-1).astype(jnp.int32)) == 1
-        rB_cts = owner_cat(recvB["cts"], cts_e)
-
-        vtxnB = TxnState(
-            status=jnp.where(rB_commit, STATUS_RUNNING, STATUS_FREE),
-            cursor=jnp.ones(Bv, jnp.int32),
-            ts=rB_cts,
-            pool_idx=jnp.zeros(Bv, jnp.int32),
-            restarts=jnp.zeros(Bv, jnp.int32),
-            backoff_until=jnp.zeros(Bv, jnp.int32),
-            start_tick=jnp.zeros(Bv, jnp.int32),
-            first_start_tick=jnp.zeros(Bv, jnp.int32),
-            keys=rB_key[:, None],
-            is_write=rB_iw[:, None],
-            n_req=jnp.where(rB_commit, 1, 0),
-            txn_type=jnp.zeros(Bv, jnp.int32),
-            targs=jnp.zeros((Bv, 1), jnp.int32),
-            aux=jnp.zeros((Bv, 1), jnp.int32),
-        )
-        vdbB = dict(db)
-        if plugin.commit_ts_field:
-            vdbB[plugin.commit_ts_field] = rB_cts
-        if normal:
-            vdbB = plugin.on_commit(cfg, vdbB, vtxnB, rB_commit,
-                                    commit_ts=rB_cts, tick=t)
-        if dly and plugin.release_on_vabort:
-            ffin_loc = fflag_flat[:nE] & local_e
-            fmask = jnp.concatenate(
-                [(recvB["final"].reshape(-1) == 1)
+            recvB = routing.exchange(sendB, AXIS)
+            # mesh: delivered commit-effect entries at both ends (a deferred
+            # txn's packed entries DID travel; the owner drops them via the
+            # commit flag, not the wire)
+            stats = obs_mesh.note_commit_exchange(
+                stats, dest, commit_e & ~local_e & ~ovfB, recvB["key"],
+                measuring)
+            # owner view = received remote commit entries + my own local ones
+            # (local lanes use the FINAL commit/final masks directly — no
+            # re-gather needed, they never packed)
+            cfin_loc = cflag_flat[:nE] & local_e
+            rB_key = owner_cat(recvB["key"],
+                               jnp.where(commit_e & local_e, key_l, NULL_KEY),
+                               NULL_KEY)
+            rB_commit = jnp.concatenate(
+                [(recvB["commit"].reshape(-1) == 1)
                  & (recvB["key"].reshape(-1) != NULL_KEY),
-                 ffin_loc])
-            vdbB = plugin.on_finalize_entries(cfg, vdbB, rB_key, rB_cts,
-                                              fmask)
-        db = {**db, **{k: v for k, v in vdbB.items()
-                       if k not in plugin.txn_db_fields
-                       and k != plugin.commit_ts_field}}
-        if normal and plugin.commit_forward_push:
-            # commit-time forward validation (RFIN at the owner,
-            # row_maat.cpp:208-307): globally-committed entries push the
-            # live row members that never saw them.  The live view is the
-            # A-phase owner lanes (held + granted-this-tick); the pushed
-            # bounds ride home on a third exchange leg reusing the
-            # A-phase pack permutation.
-            rB_atick = owner_cat(recvB["atick"], fieldsB["atick"])
-            rB_fts = owner_cat(recvB["fts"], fieldsB["fts"])
-            rB_loclo = owner_cat(recvB["loclo"], fieldsB["loclo"])
-            fresh_g = dec.grant.reshape(-1) & ~o_held & o_live
-            lo_push, up_push = plugin.commit_forward_entries(
-                cfg,
-                {"key": rB_key, "cts": rB_cts, "iw": rB_iw,
-                 "atick": rB_atick, "ts": rB_fts, "loclo": rB_loclo,
-                 "commit": rB_commit},
-                {"key": o_key, "iw": o_iw, "atick": o_stick, "ts": o_ts,
-                 "live": o_held | fresh_g})
-            backC = {"lo": lo_push[:nR].reshape(n_nodes, cap),
-                     "up": up_push[:nR].reshape(n_nodes, cap)}
-            retC = routing.exchange(backC, AXIS)
-            gotC = routing.unpack(
-                retC, orig, nE,
-                {"lo": jnp.zeros(nE + 1, jnp.int32),
-                 "up": jnp.full(nE + 1, BIG_TS, jnp.int32)})
-            lo_home = jnp.where(local_e, lo_push[nR:],
-                                gotC["lo"][:nE]).reshape(B, R)
-            up_home = jnp.where(local_e, up_push[nR:],
-                                gotC["up"][:nE]).reshape(B, R)
-            flo, fup = plugin.forward_push_fields
-            db = {**db,
-                  flo: jnp.maximum(db[flo], lo_home.max(axis=1)),
-                  fup: jnp.minimum(db[fup], up_home.min(axis=1))}
-        if apply_writes:
-            data = data.at[jnp.where(rB_commit & rB_iw, rB_key,
-                                     NULL_KEY)].add(1, mode="drop")
-        if workload.has_effects and apply_writes:
-            tables = workload.apply_commit_entries(
-                cfg, tables, rB_key, node_id,
-                {f: owner_cat(recvB[f], flds[f].reshape(-1))
-                 for f in workload.effect_fields},
-                rB_cts, rB_commit)
+                 cfin_loc])
+            rB_iw = owner_cat(recvB["iw"],
+                              txn.is_write.reshape(-1).astype(jnp.int32)) == 1
+            rB_cts = owner_cat(recvB["cts"], cts_e)
+
+            vtxnB = TxnState(
+                status=jnp.where(rB_commit, STATUS_RUNNING, STATUS_FREE),
+                cursor=jnp.ones(Bv, jnp.int32),
+                ts=rB_cts,
+                pool_idx=jnp.zeros(Bv, jnp.int32),
+                restarts=jnp.zeros(Bv, jnp.int32),
+                backoff_until=jnp.zeros(Bv, jnp.int32),
+                start_tick=jnp.zeros(Bv, jnp.int32),
+                first_start_tick=jnp.zeros(Bv, jnp.int32),
+                keys=rB_key[:, None],
+                is_write=rB_iw[:, None],
+                n_req=jnp.where(rB_commit, 1, 0),
+                txn_type=jnp.zeros(Bv, jnp.int32),
+                targs=jnp.zeros((Bv, 1), jnp.int32),
+                aux=jnp.zeros((Bv, 1), jnp.int32),
+            )
+            vdbB = dict(db)
+            if plugin.commit_ts_field:
+                vdbB[plugin.commit_ts_field] = rB_cts
+            if normal:
+                vdbB = plugin.on_commit(cfg, vdbB, vtxnB, rB_commit,
+                                        commit_ts=rB_cts, tick=t)
+            if dly and plugin.release_on_vabort:
+                ffin_loc = fflag_flat[:nE] & local_e
+                fmask = jnp.concatenate(
+                    [(recvB["final"].reshape(-1) == 1)
+                     & (recvB["key"].reshape(-1) != NULL_KEY),
+                     ffin_loc])
+                vdbB = plugin.on_finalize_entries(cfg, vdbB, rB_key, rB_cts,
+                                                  fmask)
+            db = {**db, **{k: v for k, v in vdbB.items()
+                           if k not in plugin.txn_db_fields
+                           and k != plugin.commit_ts_field}}
+            if rcache:
+                # owner-side invalidation: on_commit's row scatters are the
+                # only row-state mutation, so each committed entry bumps its
+                # row's bucket clock — every cached verdict for that bucket
+                # goes stale cluster-wide at the next tick-start gather.
+                # Bucket collisions only invalidate EARLY (one-sided safe);
+                # scatter-add commutes, so duplicate rows per bucket are
+                # race-free.
+                Kb = cfg.remote_cache_buckets
+                db = {**db, "rc_owner_epoch": db["rc_owner_epoch"].at[
+                    jnp.where(rB_commit, rB_key % Kb, Kb)].add(
+                        1, mode="drop")}
+            if normal and plugin.commit_forward_push:
+                # commit-time forward validation (RFIN at the owner,
+                # row_maat.cpp:208-307): globally-committed entries push the
+                # live row members that never saw them.  The live view is the
+                # A-phase owner lanes (held + granted-this-tick); the pushed
+                # bounds ride home on a third exchange leg reusing the
+                # A-phase pack permutation.
+                rB_atick = owner_cat(recvB["atick"], fieldsB["atick"])
+                rB_fts = owner_cat(recvB["fts"], fieldsB["fts"])
+                rB_loclo = owner_cat(recvB["loclo"], fieldsB["loclo"])
+                fresh_g = dec.grant.reshape(-1) & ~o_held & o_live
+                lo_push, up_push = plugin.commit_forward_entries(
+                    cfg,
+                    {"key": rB_key, "cts": rB_cts, "iw": rB_iw,
+                     "atick": rB_atick, "ts": rB_fts, "loclo": rB_loclo,
+                     "commit": rB_commit},
+                    {"key": o_key, "iw": o_iw, "atick": o_stick, "ts": o_ts,
+                     "live": o_held | fresh_g})
+                backC = {"lo": lo_push[:nR].reshape(n_nodes, cap),
+                         "up": up_push[:nR].reshape(n_nodes, cap)}
+                retC = routing.exchange(backC, AXIS)
+                gotC = routing.unpack(
+                    retC, orig, nE,
+                    {"lo": jnp.zeros(nE + 1, jnp.int32),
+                     "up": jnp.full(nE + 1, BIG_TS, jnp.int32)})
+                lo_home = jnp.where(local_e, lo_push[nR:],
+                                    gotC["lo"][:nE]).reshape(B, R)
+                up_home = jnp.where(local_e, up_push[nR:],
+                                    gotC["up"][:nE]).reshape(B, R)
+                flo, fup = plugin.forward_push_fields
+                db = {**db,
+                      flo: jnp.maximum(db[flo], lo_home.max(axis=1)),
+                      fup: jnp.minimum(db[fup], up_home.min(axis=1))}
+            if apply_writes:
+                data = data.at[jnp.where(rB_commit & rB_iw, rB_key,
+                                         NULL_KEY)].add(1, mode="drop")
+            if workload.has_effects and apply_writes:
+                tables = workload.apply_commit_entries(
+                    cfg, tables, rB_key, node_id,
+                    {f: owner_cat(recvB[f], flds[f].reshape(-1))
+                     for f in workload.effect_fields},
+                    rB_cts, rB_commit)
 
         # ---- command log + replication (home side) ----
         if cfg.logging:
@@ -992,7 +1331,7 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
         if dly:
             stats = obs_flight.track_net(stats, net_wait_b, measuring)
         else:
-            rem_b = (live_e & ~local_e).reshape(B, R).sum(axis=1)
+            rem_b = ship.reshape(B, R).sum(axis=1)
             stats = obs_flight.track_net(stats, rem_b, measuring)
 
         # ---- 6. commit/abort bookkeeping (home) ----
@@ -1148,6 +1487,17 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
             txn_ = txn_._replace(
                 ts=jnp.maximum(txn_.ts - by * node_stride, 1))
             db_ = plugin.on_ts_rebase(cfg, db_, by * node_stride)
+            if rcache:
+                # cached row contributions are timestamp-valued row
+                # snapshots (the remote_cache_fields contract) — shift
+                # with the plugin planes' 0-stays-never idiom so replays
+                # merge consistently post-rebase
+                sh = by * node_stride
+                db_ = {**db_, **{
+                    "rc_" + f: jnp.where(
+                        db_["rc_" + f] > 0,
+                        jnp.maximum(db_["rc_" + f] - sh, 1), 0)
+                    for f in plugin.remote_cache_fields}}
             return txn_, db_, tsc - by
 
         txn, db, ts_counter = jax.lax.cond(
@@ -1181,6 +1531,52 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
             return tick_fn(state, node_id)
 
     return tick_fused
+
+
+def exchange_capacity(cfg: Config, plugin, B: int, R: int) -> int:
+    """Per-(src, dst) exchange-A lane capacity — device-free, so the
+    16/64-node sizing math is unit-testable without a 16-device mesh.
+
+    Standard plugins size for the expected remote share with
+    ``route_capacity_factor`` slack (an overflow aborts its txn —
+    counted, rare at sane factors).  Plugins with no abort path
+    (CALVIN) cannot drop entries; without ``Config.exchange_split``
+    the exchange ships the worst case (``cap = B*R``, one destination
+    owning everything), whose owner-side width ``N*B*R`` must fit the
+    packed arbitration sort index (cc/twopl.py) — a hard 2^23
+    cluster-growth ceiling.  With the split exchange the epoch ships
+    in trace-time-static sub-rounds of at most ``cap`` entries per
+    destination: the owner sees ``N*cap`` lanes per round, decisions
+    come from per-row aggregates rather than a packed sort, and no
+    worst-case buffer or 2^23 guard exists on this path — memory and
+    sub-round count scale with the capacity factor, not the cluster.
+    """
+    N = cfg.node_cnt
+    cap = max(int(B * R / cfg.part_cnt * cfg.route_capacity_factor), R)
+    if plugin.never_aborts:
+        if cfg.exchange_split:
+            return min(cap, B * R)
+        # Calvin has no abort path, and a dropped HELD entry would be
+        # invisible to the row owner — another writer could grant and
+        # break the deterministic FIFO schedule.  Size the exchange for
+        # the worst case (all of a node's B*R entries to one dest) so
+        # overflow is structurally impossible.  Owner-side arbitration
+        # then sees N*B*R virtual entries, which must fit the packed
+        # sort-index width (cc/twopl.py).
+        if N * B * R > 1 << 23:
+            raise ValueError(
+                f"CALVIN worst-case exchange overflows the packed "
+                f"arbitration index: node_cnt={N} x batch_size={B} x "
+                f"max_req={R} = {N * B * R} owner-side entries "
+                f"exceeds the 2^23 bound (cc/twopl.py packed sort "
+                f"keys).  Set exchange_split=True (the capacity-"
+                f"bounded epoch-split exchange ships sub-rounds of "
+                f"route_capacity_factor-sized windows and has no "
+                f"worst-case buffer), lower batch_size, or shard the "
+                f"epoch by setting seq_batch_size below the current "
+                f"epoch_size={cfg.epoch_size}.")
+        return B * R
+    return cap
 
 
 class ShardedEngine:
@@ -1243,29 +1639,7 @@ class ShardedEngine:
             for k in all_keys}
 
         B, R = cfg.batch_size, pool.max_req
-        self.cap = max(int(B * R / cfg.part_cnt
-                           * cfg.route_capacity_factor), R)
-        if self.plugin.never_aborts:
-            # Calvin has no abort path, and a dropped HELD entry would be
-            # invisible to the row owner — another writer could grant and
-            # break the deterministic FIFO schedule.  Size the exchange for
-            # the worst case (all of a node's B*R entries to one dest) so
-            # overflow is structurally impossible.  Owner-side arbitration
-            # then sees N*B*R virtual entries, which must fit the packed
-            # sort-index width (cc/twopl.py); scale past this bound needs a
-            # hierarchical exchange, not a bigger buffer.
-            self.cap = B * R
-            if N * B * R > 1 << 23:
-                raise ValueError(
-                    f"CALVIN worst-case exchange overflows the packed "
-                    f"arbitration index: node_cnt={N} x batch_size={B} x "
-                    f"max_req={R} = {N * B * R} owner-side entries "
-                    f"exceeds the 2^23 bound (cc/twopl.py packed sort "
-                    f"keys).  Lower batch_size, or shard the epoch by "
-                    f"setting seq_batch_size below the current "
-                    f"epoch_size={cfg.epoch_size}; scale past this bound "
-                    f"needs the hierarchical exchange of ROADMAP item 2, "
-                    f"not a bigger buffer.")
+        self.cap = exchange_capacity(cfg, self.plugin, B, R)
 
         self._tick_inner = None  # built lazily per pool shard inside spmd
 
@@ -1293,6 +1667,17 @@ class ShardedEngine:
 
         def one(part):
             db = self.plugin.init_db(cfg, rows_local, B, R)
+            if cfg.remote_cache and self.plugin.remote_cache_ok:
+                # remote-grant stickiness planes (Config.remote_cache):
+                # per-entry cached verdicts + contributions, the learned
+                # owner bucket clocks, and this node's own (K,) clocks
+                db = {**db,
+                      "rc_valid": jnp.zeros((B, R), dtype=bool),
+                      "rc_epoch": jnp.zeros((B, R), jnp.int32),
+                      "rc_owner_epoch": jnp.zeros(
+                          cfg.remote_cache_buckets, jnp.int32),
+                      **{"rc_" + f: jnp.zeros((B, R), jnp.int32)
+                         for f in self.plugin.remote_cache_fields}}
             return ShardState(
                 txn=TxnState.empty(B, R, A=self.pool.args.shape[1]),
                 db=db,
@@ -1324,7 +1709,23 @@ class ShardedEngine:
                            jnp.full(cfg.fault_elog_cap, -1, jnp.int32),
                            "fault_elog_lsn": jnp.zeros((), jnp.int32)}
                           if cfg.faults and self.plugin.epoch_admission
-                          else {})},
+                          else {}),
+                       # epoch-split exchange: occupied sub-rounds per
+                       # measured tick (Config.exchange_split)
+                       **({"exchange_round_cnt": jnp.zeros((), jnp.int32)}
+                          if cfg.exchange_split
+                          and self.plugin.never_aborts else {}),
+                       # remote-grant stickiness counters
+                       # (Config.remote_cache): attempts == shipped
+                       # (remote_entry_cnt) + suppressed, reconciled in
+                       # obs/mesh.py
+                       **({"remote_attempt_cnt": jnp.zeros((), jnp.int32),
+                           "remote_cache_hit_cnt":
+                           jnp.zeros((), jnp.int32),
+                           "reship_suppressed_cnt":
+                           jnp.zeros((), jnp.int32)}
+                          if cfg.remote_cache
+                          and self.plugin.remote_cache_ok else {})},
                 tick=jnp.zeros((), jnp.int32),
                 pool_cursor=jnp.zeros((), jnp.int32),
                 ts_counter=jnp.ones((), jnp.int32),

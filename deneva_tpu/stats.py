@@ -166,6 +166,21 @@ def reference_summary(s: dict, wall_seconds: float | None = None) -> dict:
     for k in sorted(s):
         if k.startswith(_FAULT_PREFIXES) and k not in out:
             out[k] = s[k]
+    # scale-out keys (Config.exchange_split / Config.remote_cache,
+    # parallel/sharded.py): occupied sub-round counts and the remote
+    # cache attempt/hit/suppression counters pass through verbatim
+    # (integers, never time-scaled).  remote_entry_cnt joins the line
+    # ONLY when the cache is on, so the attempts == shipped + suppressed
+    # identity (obs/mesh.py reconcile) is checkable from the line alone
+    # while the default line stays byte-identical.
+    _SCALEOUT_PREFIXES = ("exchange_", "remote_attempt_", "remote_cache_",
+                          "reship_")
+    for k in sorted(s):
+        if k.startswith(_SCALEOUT_PREFIXES) and k.endswith("_cnt") \
+                and k not in out:
+            out[k] = s[k]
+    if "remote_attempt_cnt" in s and "remote_entry_cnt" in s:
+        out.setdefault("remote_entry_cnt", s["remote_entry_cnt"])
     for k in sorted(s):
         if k.startswith("famlat") and k not in out:
             out[k] = s[k] * tick_sec if isinstance(s[k], float) else s[k]

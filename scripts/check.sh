@@ -226,6 +226,99 @@ if [ "$faults_rc" -ne 0 ]; then
     exit "$faults_rc"
 fi
 
+echo "== scale smoke (16-node cells + 64-node split dryrun) =="
+# the scale-out path (Config.exchange_split / Config.remote_cache): a
+# 16-virtual-node NO_WAIT cell must run and reconcile its mesh matrix
+# exactly; a 16-node CALVIN cell must run under the capacity-bounded
+# epoch-split exchange with a buffer strictly below the worst case
+# (eng.cap < B*R); the config shape the single-round exchange REFUSES
+# (its 2^23 guard) must construct under exchange_split; and a 64-node
+# CALVIN split cell must trace end-to-end (make_jaxpr dryrun) with no
+# worst-case B*R allocation anywhere
+env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=16" \
+    python - <<'PYEOF'
+import numpy as np
+from deneva_tpu.config import Config
+from deneva_tpu.obs import mesh as obs_mesh
+from deneva_tpu.parallel import sharded
+from deneva_tpu.parallel.sharded import ShardedEngine
+
+KW = dict(synth_table_size=1 << 12, req_per_query=4, zipf_theta=0.6,
+          tup_read_perc=0.5, query_pool_size=1 << 10, warmup_ticks=0,
+          mpr=1.0, part_per_txn=2)
+
+# 16-node NO_WAIT: runs, commits, mesh matrix reconciles exactly
+cfg = Config(cc_alg="NO_WAIT", node_cnt=16, part_cnt=16, batch_size=32,
+             mesh=True, **KW)
+eng = ShardedEngine(cfg)
+st = eng.run(20)
+s = eng.summary(st)
+assert s["txn_cnt"] > 0, "16-node NO_WAIT cell committed nothing"
+bad = obs_mesh.reconcile(eng.mesh_snapshot(st), s)
+assert bad == [], f"16-node mesh failed to reconcile: {bad}"
+print(f"[scale] NO_WAIT 16n: {s['txn_cnt']} commits, "
+      f"{s['mesh_tx_total']} msgs reconciled")
+
+# 16-node CALVIN under the split exchange: capacity-bounded buffer
+cfg = Config(cc_alg="CALVIN", node_cnt=16, part_cnt=16, batch_size=32,
+             exchange_split=True, mesh=True, **KW)
+eng = ShardedEngine(cfg)
+assert eng.cap < cfg.batch_size * cfg.req_per_query, \
+    f"split cap {eng.cap} not below worst case"
+st = eng.run(20)
+s = eng.summary(st)
+assert s["txn_cnt"] > 0, "16-node CALVIN split cell committed nothing"
+bad = obs_mesh.reconcile(eng.mesh_snapshot(st), s)
+assert bad == [], f"16-node CALVIN mesh failed to reconcile: {bad}"
+print(f"[scale] CALVIN 16n split: cap {eng.cap} (worst case "
+      f"{cfg.batch_size * cfg.req_per_query}), {s['txn_cnt']} commits")
+
+# the shape the single-round exchange refuses (N*B*R > 2^23) must
+# construct once split; the worst-case capacity call must still raise
+big = dict(cc_alg="CALVIN", node_cnt=16, part_cnt=16, batch_size=8192,
+           req_per_query=128, synth_table_size=1 << 16,
+           query_pool_size=1 << 10, warmup_ticks=0, mpr=1.0,
+           part_per_txn=2)
+try:
+    ShardedEngine(Config(**big))
+    raise SystemExit("worst-case CALVIN capacity failed to raise")
+except ValueError as e:
+    assert "exchange_split" in str(e), e
+cap = ShardedEngine(Config(**big, exchange_split=True)).cap
+assert cap < 8192 * 128, cap
+print(f"[scale] 16n x 8192 x 128 CALVIN: guard raises without split, "
+      f"cap {cap} with it")
+PYEOF
+scale_rc=$?
+if [ "$scale_rc" -eq 0 ]; then
+    # 64-node dryrun: the full split tick must TRACE with the bounded
+    # buffer (worst-case allocation would show up at trace time)
+    env JAX_PLATFORMS=cpu \
+        XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=64" \
+        python - <<'PYEOF'
+import jax
+from deneva_tpu.config import Config
+from deneva_tpu.parallel import sharded
+
+cfg = Config(cc_alg="CALVIN", node_cnt=64, part_cnt=64, batch_size=32,
+             exchange_split=True, synth_table_size=1 << 12,
+             req_per_query=4, query_pool_size=1 << 10, warmup_ticks=0,
+             mpr=1.0, part_per_txn=2)
+eng = sharded.ShardedEngine(cfg)
+eng._build()
+jax.make_jaxpr(eng._tick_raw)(eng.init_state())
+assert eng.cap < cfg.batch_size * cfg.req_per_query, eng.cap
+print(f"[scale] CALVIN 64n split dryrun traced, cap {eng.cap} "
+      f"(worst case {cfg.batch_size * cfg.req_per_query})")
+PYEOF
+    scale_rc=$?
+fi
+if [ "$scale_rc" -ne 0 ]; then
+    echo "scale smoke FAILED (rc=$scale_rc)"
+    exit "$scale_rc"
+fi
+
 echo "== bench regression gate =="
 # gate the latest trajectory point (committed BENCH_r*.json snapshots +
 # any results/bench_history.jsonl) against the median of its priors;

@@ -125,6 +125,43 @@ def test_matrix_reconciles_exactly(alg):
     assert tx[:, :, obs_mesh.RESP].sum() > 0
 
 
+def test_split_exchange_reconciles_and_matches_baseline():
+    """Config.exchange_split (the capacity-bounded epoch-split
+    exchange): the CALVIN cell still reconciles its traffic matrix
+    EXACTLY — exchange B's receive side arrives as per-sub-round counts
+    (note_commit_exchange_counts) and must meet the same tx == rx
+    identities — and the [summary] is bit-identical to the single-round
+    exchange's, the split path adding only its sub-round counter."""
+    eng_b, _, s_base = cell("CALVIN", mesh=True)
+    eng, st, s = cell("CALVIN", mesh=True, exchange_split=True,
+                      route_capacity_factor=0.25)
+    assert eng.cap < eng_b.cap                # genuinely capacity-bounded
+    assert obs_mesh.reconcile(eng.mesh_snapshot(st), s) == []
+    assert set(s) - set(s_base) == {"exchange_round_cnt"}
+    assert s["exchange_round_cnt"] > 0
+    for k in s_base:
+        assert s[k] == s_base[k], (k, s[k], s_base[k])
+
+
+def test_remote_cache_counters_and_attempts_identity():
+    """Config.remote_cache (remote-grant stickiness): the MAAT cell's
+    cache counters join the summary, every suppressed re-ship is an
+    attempt the mesh never saw (attempts == shipped + suppressed, the
+    reconcile() identity), and the matrix still reconciles exactly."""
+    _, _, s_base = cell("MAAT", mesh=True)
+    eng, st, s = cell("MAAT", mesh=True, remote_cache=True)
+    assert obs_mesh.reconcile(eng.mesh_snapshot(st), s) == []
+    for k in ("remote_attempt_cnt", "remote_cache_hit_cnt",
+              "reship_suppressed_cnt"):
+        assert k in s and k not in s_base, k
+    assert (s["remote_attempt_cnt"]
+            == s["remote_entry_cnt"] + s["reship_suppressed_cnt"])
+    assert s["reship_suppressed_cnt"] > 0, \
+        "contended 2-node MAAT cell must suppress some re-ships"
+    assert s["remote_entry_cnt"] < s_base["remote_entry_cnt"], \
+        "stickiness must cut shipped remote entries"
+
+
 @pytest.mark.slow  # extra warmup-variant compile; tier-1 budget split
 def test_matrix_reconciles_with_warmup():
     """The accumulation gate mirrors the bump() warmup gate on every
@@ -308,5 +345,10 @@ def test_scaling_grid_cell(tmp_path):
     # with a copied point rather than a duplicated list reference
     res = obs_regress.gate(entries, current=dict(entries[-1]))
     assert any(c["name"].startswith("scaling_grid_efficiency[MAAT@")
+               for c in res["checks"])
+    # the amplification ratio rides the same cells, gated INVERTED
+    # (remote entries shipped per requested access; growth = regression)
+    assert entries[-1]["scaling_amp"]
+    assert any(c["name"].startswith("scaling_grid_amplification[MAAT@")
                for c in res["checks"])
     assert res["failures"] == []

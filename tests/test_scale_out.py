@@ -1,0 +1,162 @@
+"""Scale-out tests: the capacity-bounded epoch-split exchange
+(Config.exchange_split) and remote-grant stickiness
+(Config.remote_cache), parallel/sharded.py.
+
+The split exchange replaces CALVIN's worst-case single-round buffer
+(cap = B*R, whose owner-side width N*B*R must fit the packed
+arbitration sort index — a hard 2^23 cluster-growth ceiling) with
+trace-time-static sub-rounds of at most ``cap`` entries per
+destination: held entries structurally always ship (delay, never
+drop), so the guard disappears on the split path and 16-64 node
+clusters construct.  The covering contract is bit-parity: on any
+config both exchanges must produce the identical schedule, data array
+included.  Remote-grant stickiness suppresses re-shipping decided
+entries after an abort; every suppression must be visible in the
+attempt counters (attempts == shipped + suppressed).
+
+The mesh-identity and stats-line legs live in tests/test_mesh.py and
+tests/test_stats.py; this file pins the sizing math, the 4-node oracle
+parity, trait gating, the inverted regression gate, and — in a
+subprocess with a 16-device platform — that the previously-raising
+16-node CALVIN shape now constructs and dry-runs.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from deneva_tpu import cc as cc_registry
+from deneva_tpu.config import Config
+from deneva_tpu.parallel.sharded import ShardedEngine, exchange_capacity
+
+BASE = dict(cc_alg="CALVIN", node_cnt=4, part_cnt=4, batch_size=32,
+            synth_table_size=1 << 10, query_pool_size=256,
+            req_per_query=4, warmup_ticks=2)
+
+
+def test_exchange_capacity_guard_names_the_split_flag():
+    """The CALVIN worst-case guard still fires without the split — and
+    its remediation text now points at exchange_split; with the split
+    the same shape gets a bounded capacity instead of an error."""
+    plugin = cc_registry.get("CALVIN")
+    big = Config(cc_alg="CALVIN", node_cnt=16, part_cnt=16,
+                 batch_size=8192, req_per_query=128,
+                 synth_table_size=1 << 16)
+    with pytest.raises(ValueError, match="exchange_split"):
+        exchange_capacity(big, plugin, 8192, 128)      # 2^24 > 2^23
+    cap = exchange_capacity(
+        Config(cc_alg="CALVIN", node_cnt=16, part_cnt=16,
+               batch_size=8192, req_per_query=128,
+               synth_table_size=1 << 16, exchange_split=True),
+        plugin, 8192, 128)
+    assert 0 < cap < 8192 * 128
+    # standard (abort-capable) plugins never hit the guard
+    assert exchange_capacity(big, cc_registry.get("MAAT"), 8192, 128) \
+        < 8192 * 128
+
+
+def test_split_capacity_is_bounded_not_worst_case():
+    """Under the split the capacity follows route_capacity_factor, not
+    the B*R worst case — the whole point of the sub-rounds."""
+    plugin = cc_registry.get("CALVIN")
+    cfg = Config(**{**BASE, "exchange_split": True,
+                    "route_capacity_factor": 0.25})
+    assert exchange_capacity(cfg, plugin, 32, 4) < 32 * 4
+    assert exchange_capacity(Config(**BASE), plugin, 32, 4) == 32 * 4
+
+
+def test_split_exchange_bit_parity_on_oracle_cell():
+    """The 4-node CALVIN oracle cell: every summary counter AND the
+    row-version data array must be bit-identical between the
+    single-round exchange and the split exchange at a capacity small
+    enough to force many sub-rounds per epoch."""
+    e0 = ShardedEngine(Config(**BASE))
+    e1 = ShardedEngine(Config(**{**BASE, "exchange_split": True,
+                                 "route_capacity_factor": 0.25}))
+    assert e1.cap < e0.cap
+    s0, s1 = e0.run(20), e1.run(20)
+    a, b = e0.summary(s0), e1.summary(s1)
+    assert set(b) - set(a) == {"exchange_round_cnt"}
+    assert b["exchange_round_cnt"] > 20      # multiple sub-rounds/tick
+    for k in a:
+        assert a[k] == b[k], (k, a[k], b[k])
+    assert np.array_equal(np.asarray(s0.data), np.asarray(s1.data))
+
+
+def test_flags_are_trait_gated_statically():
+    """Trait-disjoint combinations stay statically OFF: exchange_split
+    on an abort-capable plugin (MAAT) and remote_cache on a
+    deterministic one (CALVIN) must add NO device state — the certifier
+    proves the jaxpr fixed point, this pins the runtime surface."""
+    on = ShardedEngine(Config(**{**BASE, "cc_alg": "MAAT",
+                                 "remote_cache": True})).init_state()
+    assert any(k.startswith("rc_") or k == "remote_attempt_cnt"
+               for k in {**on.db, **on.stats}), \
+        "MAAT + remote_cache must carry the cache planes"
+    for cfg in (Config(**{**BASE, "exchange_split": True,
+                          "cc_alg": "MAAT"}),
+                Config(**{**BASE, "remote_cache": True})):
+        st = ShardedEngine(cfg).init_state()
+        assert not any(k.startswith("rc_") for k in {**st.db, **st.stats})
+        assert "exchange_round_cnt" not in st.stats
+        assert "remote_attempt_cnt" not in st.stats
+
+
+def test_regress_gates_amplification_inverted():
+    """obs/regress.py: the per-cell amplification ratio is gated as a
+    CEILING — growth past (1 + tol) x median fails, a cut passes —
+    while efficiency keeps its floor semantics."""
+    from deneva_tpu.obs import regress as obs_regress
+
+    def entry(amp, eff):
+        return {"metric": "scaling_grid", "value": eff,
+                "scaling_grid": {"MAAT@8x256": {
+                    "efficiency": eff, "amplification": amp}}}
+
+    hist = [obs_regress._entry("h", (1, i), entry(8.44, 0.24))
+            for i in range(3)]
+    good = obs_regress.gate(
+        hist + [obs_regress._entry("cur", (1, 9), entry(3.99, 0.42))])
+    assert good["failures"] == []
+    bad = obs_regress.gate(
+        hist + [obs_regress._entry("cur", (1, 9), entry(12.0, 0.24))])
+    assert any("scaling_grid_amplification[MAAT@8x256]" in f
+               for f in bad["failures"])
+
+
+@pytest.mark.slow  # fresh 16-device JAX process; tier-1 budget split
+def test_sixteen_node_calvin_constructs_and_dryruns():
+    """Regression for the 2^23 ceiling: a 16-node CALVIN cluster — any
+    shape of which the single-round exchange could only build below
+    N*B*R <= 2^23 — constructs under exchange_split with a bounded
+    buffer and its full sharded tick traces end-to-end.  Runs in a
+    subprocess so the 16 virtual devices don't disturb the suite's
+    8-device platform."""
+    script = textwrap.dedent("""
+        import jax
+        from deneva_tpu.config import Config
+        from deneva_tpu.parallel.sharded import ShardedEngine
+        cfg = Config(cc_alg="CALVIN", node_cnt=16, part_cnt=16,
+                     batch_size=32, synth_table_size=1 << 12,
+                     req_per_query=4, query_pool_size=1 << 10,
+                     warmup_ticks=0, mpr=1.0, part_per_txn=2,
+                     exchange_split=True)
+        eng = ShardedEngine(cfg)
+        assert eng.cap < cfg.batch_size * cfg.req_per_query, eng.cap
+        eng._build()
+        jax.make_jaxpr(eng._tick_raw)(eng.init_state())
+        print("DRYRUN_OK cap", eng.cap)
+    """)
+    env = {**os.environ,
+           "JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=16"}
+    out = subprocess.run([sys.executable, "-c", script], env=env,
+                         capture_output=True, text=True, timeout=420,
+                         cwd=os.path.dirname(os.path.dirname(
+                             os.path.abspath(__file__))))
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "DRYRUN_OK" in out.stdout

@@ -256,3 +256,40 @@ def test_cc_counters_sharded_sum_across_nodes():
     s = eng.summary(st)
     assert s["occ_hist_abort_cnt"] + s["occ_active_abort_cnt"] \
         >= s["vabort_cnt"]
+
+
+def test_scale_out_keys_round_trip_exactly():
+    """Scale-out runs (Config.exchange_split / Config.remote_cache,
+    parallel/sharded.py) put the exchange sub-round count and the
+    remote-grant cache counters on the [summary] line; the stats layer
+    passes them through VERBATIM (integers, never time-scaled), they
+    round-trip through the parser port exactly — with remote_entry_cnt
+    pulled onto the line so the attempts == shipped + suppressed
+    identity is checkable from the line alone — and the default line
+    carries none."""
+    eng, st = run_engine()
+    s = eng.summary(st)
+    # the passthrough is engine-agnostic: inject the documented key set
+    # (tests/test_mesh.py covers the sharded engine producing them)
+    scale = {"exchange_round_cnt": 522, "remote_attempt_cnt": 18749,
+             "remote_cache_hit_cnt": 1855, "reship_suppressed_cnt": 7593,
+             "remote_entry_cnt": 11156}
+    assert (scale["remote_attempt_cnt"]
+            == scale["remote_entry_cnt"] + scale["reship_suppressed_cnt"])
+    d1 = stats_mod.reference_summary({**s, **scale})
+    d2 = stats_mod.reference_summary({**s, **scale},
+                                     wall_seconds=s["measured_ticks"]
+                                     * 2.0)
+    for k, v in scale.items():
+        assert d1[k] == v, k                       # verbatim
+        assert d2[k] == v, k                       # never time-scaled
+    parsed = stats_mod.parse_summary(stats_mod.format_summary(d1))
+    for k, v in scale.items():
+        assert parsed[k] == pytest.approx(v)
+    assert (parsed["remote_attempt_cnt"] == parsed["remote_entry_cnt"]
+            + parsed["reship_suppressed_cnt"])
+    # the default line carries none of them
+    p0 = stats_mod.parse_summary(eng.summary_line(st, wall_seconds=1.0))
+    assert not any(k.startswith(("exchange_", "remote_attempt_",
+                                 "remote_cache_", "reship_"))
+                   for k in p0)
