@@ -779,6 +779,129 @@ def run_flight(args, out_dir: str = "results", history: bool = True) -> int:
     return code
 
 
+# the contended adaptive-controller cell (--adaptive): zipf 0.9 on a
+# small table at a batch big enough that the acceptance shape (B >= 2048)
+# holds on CPU; admit_cap keeps steady-state in-flight pressure high but
+# not degenerate.  The HOT variant replays the same shape through the
+# reference's SKEW_METHOD==HOT generator (Config.skew_method).
+ADAPT_KW = dict(
+    batch_size=2048, synth_table_size=1 << 12, req_per_query=4,
+    zipf_theta=0.9, tup_read_perc=0.5, query_pool_size=1 << 12,
+    warmup_ticks=0, admit_cap=256,
+)
+
+#: the static-backoff ladder the adaptive controller must beat: the
+#: reference's fixed ABORT_PENALTY at 1/4/16 ticks plus backoff OFF
+_ADAPT_STATICS = (("p1", dict(abort_penalty_ticks=1)),
+                  ("p4", dict(abort_penalty_ticks=4)),
+                  ("p16", dict(abort_penalty_ticks=16)),
+                  ("nobackoff", dict(backoff=False)))
+
+#: the two contention shapes per algorithm: broad zipf skew (backoff /
+#: width territory) and the reference's HOT generator pointed at a
+#: 4-row hot set — the tiny-dominant-key regime the escalation gate
+#: exists for (a bucket must carry > 1/ctrl_esc_share of all conflict
+#: heat to escalate; ~200 warm keys would never clear that bar)
+_ADAPT_CELLS = (("zipf0.9", {}),
+                ("hot", dict(skew_method="hot", access_perc=0.95,
+                             data_perc=0.001)))
+
+_ADAPT_ALGS = ("NO_WAIT", "WAIT_DIE", "OCC", "MAAT")
+
+
+def run_adaptive(args, out_dir: str = "results",
+                 history: bool = True) -> int:
+    """--adaptive: the contended controller sweep (Config.adaptive,
+    deneva_tpu/ctrl/).
+
+    Two contention shapes — zipf 0.9 and the reference's HOT skew
+    (ACCESS_PERC=0.9 of traffic to DATA_PERC=0.05 of data) — each run
+    under NO_WAIT / WAIT_DIE / OCC / MAAT with the static-backoff
+    ladder (ABORT_PENALTY 1/4/16 ticks + backoff off) and once with the
+    adaptive controller on.  Every variant reports the chip-noise-immune
+    commits/tick; ``adaptive_vs_static`` is the per-cell ratio of the
+    adaptive number to the BEST static — the controller must not just
+    beat the default, it must beat the best hand-tuned point in the
+    ladder.  The adaptive cells also report what the controller did
+    (escalations, gate stalls, width steps, converged bases).
+
+    Writes ``<out-dir>/adaptive_sweep.json`` and appends an
+    ``adaptive_contention`` record whose ``adaptive_vs_static`` ratios
+    feed the self-arming obs/regress.py floor.
+
+    Exit code 0 when, on the zipf 0.9 cell, adaptive beats every static
+    for NO_WAIT AND for at least one of OCC/MAAT (the ISSUE acceptance
+    bar); 1 otherwise."""
+    alg_list = (list(_ADAPT_ALGS) if args.algs == "all"
+                else [a.strip().upper() for a in args.algs.split(",") if a])
+    sweep, ratios = {}, {}
+    for cell_name, cell_kw in _ADAPT_CELLS:
+        for alg in alg_list:
+            variants = {}
+            for var_name, var_kw in _ADAPT_STATICS:
+                cfg = Config(cc_alg=alg, abort_attribution=True,
+                             **ADAPT_KW, **cell_kw, **var_kw)
+                _, cpt, summ = run_cell(cfg, n_ticks=args.ticks, windows=3)
+                variants[var_name] = {
+                    "commits_per_tick": round(cpt, 2),
+                    **_abort_fields(summ)}
+            cfg = Config(cc_alg=alg, adaptive=True, abort_attribution=True,
+                         heatmap_bins=64, **ADAPT_KW, **cell_kw)
+            _, cpt, summ = run_cell(cfg, n_ticks=args.ticks, windows=3)
+            variants["adaptive"] = {
+                "commits_per_tick": round(cpt, 2),
+                **_abort_fields(summ),
+                "ctrl": {
+                    "escalations": int(summ.get("ctrl_escalate_cnt", 0)),
+                    "deescalations": int(summ.get("ctrl_deescalate_cnt", 0)),
+                    "gate_blocks": int(summ.get("ctrl_esc_block_cnt", 0)),
+                    "width_steps": int(summ.get("ctrl_width_step_cnt", 0)),
+                    "width_idx": int(summ.get("ctrl_width_idx", 0)),
+                }}
+            best_static = max(v["commits_per_tick"]
+                              for k, v in variants.items()
+                              if k != "adaptive")
+            ratio = variants["adaptive"]["commits_per_tick"] \
+                / max(best_static, 1e-9)
+            ratios[f"{alg}@{cell_name}"] = round(ratio, 4)
+            sweep[f"{alg}@{cell_name}"] = variants
+            cells = " ".join(f"{k}={v['commits_per_tick']}"
+                             for k, v in variants.items())
+            print(f"[adaptive] {alg}@{cell_name}: ratio {ratio:.3f} "
+                  f"vs best static {best_static} ({cells})")
+    # acceptance bar: on the zipf 0.9 cell adaptive must beat every
+    # static for NO_WAIT and for at least one of OCC / MAAT
+    nw = ratios.get("NO_WAIT@zipf0.9", 0.0)
+    vmax = max(ratios.get("OCC@zipf0.9", 0.0),
+               ratios.get("MAAT@zipf0.9", 0.0))
+    code = 0 if (nw > 1.0 and vmax > 1.0) else 1
+    doc = {
+        "metric": "adaptive_contention",
+        "value": nw,
+        "unit": "adaptive_over_best_static_cpt",
+        "ticks": args.ticks,
+        "adaptive_vs_static": ratios,
+        "sweep": sweep,
+        "note": "per-cell ratio of adaptive commits/tick to the BEST "
+                "static-backoff variant (ABORT_PENALTY 1/4/16 + "
+                "backoff off) on the contended ADAPT_KW shape; "
+                "value = NO_WAIT@zipf0.9; exit 0 iff NO_WAIT and one "
+                "of OCC/MAAT beat every static on the zipf 0.9 cell",
+    }
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, "adaptive_sweep.json")
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(json.dumps({k: v for k, v in doc.items() if k != "sweep"}))
+    print(f"[adaptive] sweep written: {path}")
+    if history:
+        _append_history(doc, Config(cc_alg=alg_list[0], adaptive=True,
+                                    abort_attribution=True,
+                                    heatmap_bins=64, **ADAPT_KW),
+                        out_dir)
+    return code
+
+
 def _git_commit() -> str | None:
     try:
         out = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
@@ -812,8 +935,11 @@ def _append_history(doc: dict, cfg: Config, out_dir: str = "results") -> str:
     # headline tput trajectories are untouched
     # --scaling-grid cells ride the same way: the per-cell efficiency
     # dict keys a distinct "scaling_grid" trajectory in obs/regress.py
+    # --adaptive records ride the same way: the per-cell ratio dict keys
+    # a distinct "adaptive_contention" trajectory with a self-arming
+    # floor in obs/regress.py
     for k in ("offered_load", "knee", "nodes", "batch_shapes",
-              "scaling_grid"):
+              "scaling_grid", "adaptive_vs_static"):
         if k in doc:
             rec[k] = doc[k]
     os.makedirs(out_dir, exist_ok=True)
@@ -1010,6 +1136,15 @@ def _cli():
                    help="cap on the fit_batch-derived per-node batch "
                         "shape (keeps the CPU smoke fast; raise on "
                         "real chips)")
+    p.add_argument("--adaptive", action="store_true",
+                   help="adaptive contention controller sweep: zipf 0.9 "
+                        "and HOT-skew cells x {static backoff ladder, "
+                        "Config.adaptive} for NO_WAIT/WAIT_DIE/OCC/MAAT; "
+                        "writes adaptive_sweep.json and the "
+                        "adaptive_vs_static ratios the regress gate "
+                        "floors (exit 1 unless adaptive beats every "
+                        "static for NO_WAIT + one of OCC/MAAT on the "
+                        "zipf 0.9 cell)")
     p.add_argument("--faults", action="store_true",
                    help="fault-plane smoke: kill/straggle/partition "
                         "scenarios on the 2-node sharded CALVIN cell; "
@@ -1051,6 +1186,9 @@ if __name__ == "__main__":
     if _args.offered_load:
         raise SystemExit(run_offered_load(_args, out_dir=_args.out_dir,
                                           history=not _args.no_history))
+    if _args.adaptive:
+        raise SystemExit(run_adaptive(_args, out_dir=_args.out_dir,
+                                      history=not _args.no_history))
     if _args.faults:
         raise SystemExit(run_faults(_args, out_dir=_args.out_dir,
                                     history=not _args.no_history))

@@ -200,6 +200,15 @@ class CCPlugin:
     #: so the debug invariant kernel may assert the lock matrix
     #: (engine/debug.py, row_lock.cpp:309-314).
     lock_based: bool = False
+    #: adaptive hot-key escalation gate (deneva_tpu/ctrl/ policy b): True
+    #: iff "this txn makes no request this tick" is always safe and the
+    #: key it was about to touch is where the conflict would happen.
+    #: Holds for the arrival-order plugins (2PL family, TIMESTAMP), whose
+    #: cursor access IS the conflict point; False for the validation
+    #: family (OCC/MAAT) — reads never block there and serializing them
+    #: at the access would add latency without removing any validation
+    #: conflict — and for Calvin's epoch-batched lock acquisition.
+    esc_gate_ok: bool = False
 
     # --- abort attribution (ABORT_REASONS registry above) ---
     #: registered reason names this plugin's ACCESS decisions can carry

@@ -114,6 +114,10 @@ class Maat(CCPlugin):
     #: MAAT never aborts at access time; every CC abort is a validation
     #: whose [lower, upper) range collapsed empty (maat_range_abort_cnt)
     vabort_reason = "maat_range_collapse"
+    #: adaptive escalation gate stays OFF, as for OCC: accesses always
+    #: grant (they only tighten ranges), so a cursor stall cannot prevent
+    #: a range collapse; policy (a) handles MAAT's contention instead
+    esc_gate_ok = False
 
     def init_db(self, cfg: Config, n_rows: int, B: int, R: int) -> dict:
         db = {

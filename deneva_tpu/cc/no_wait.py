@@ -28,6 +28,10 @@ from deneva_tpu.engine.state import TxnState, make_entries, NULL_KEY
 class TwoPLPlugin(CCPlugin):
     policy = "NO_WAIT"
     lock_based = True
+    # hot-key escalation gate: safe for 2PL — the cursor access is the
+    # conflict point, and an empty request window is a pure stall (every
+    # arbitration path masks requests by cursor < n_req)
+    esc_gate_ok = True
     #: lock-family access aborts carry one policy code each: NO_WAIT's
     #: conflict abort (row_lock.cpp:86-90) vs WAIT_DIE's wound
     #: (row_lock.cpp:91-151); subclasses pin the registered name

@@ -40,6 +40,11 @@ class Occ(CCPlugin):
     #: OCC never aborts at access time; every CC abort is a failed
     #: backward validation (history or active-set check)
     vabort_reason = "occ_validation"
+    #: adaptive escalation gate stays OFF: access always grants here, so
+    #: stalling a writer at its cursor removes no validation conflict —
+    #: the adaptive win for OCC comes from policy (a)'s long jittered
+    #: vabort backoff draining the conflicting cohort instead
+    esc_gate_ok = False
 
     def init_db(self, cfg: Config, n_rows: int, B: int, R: int) -> dict:
         db = {**super().init_db(cfg, n_rows, B, R),

@@ -85,6 +85,10 @@ class Timestamp(CCPlugin):
     name = "TIMESTAMP"
     new_ts_on_restart = True  # is_cc_new_timestamp(), worker_thread.cpp:492
     access_abort_reasons = ("ts_too_old_read", "ts_too_old_write")
+    # hot-key escalation gate: a stalled T/O writer retries the SAME tick
+    # logic next tick with its ts intact; meanwhile the oldest escalated
+    # writer moves wts forward once instead of killing the whole cohort
+    esc_gate_ok = True
 
     def init_db(self, cfg: Config, n_rows: int, B: int, R: int) -> dict:
         return {

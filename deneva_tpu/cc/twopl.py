@@ -301,8 +301,10 @@ def ts_groups(ts, active, K: int):
     B = ts.shape[0]
     tsk = jnp.where(active, ts, BIG_TS)
     order = jnp.argsort(tsk)
+    # order is an argsort permutation of arange(B): indices are distinct
+    # by construction, so the inverse-permutation scatter is race-free
     rank = jnp.zeros(B, jnp.int32).at[order].set(
-        jnp.arange(B, dtype=jnp.int32))
+        jnp.arange(B, dtype=jnp.int32), unique_indices=True)
     n_act = jnp.maximum(jnp.sum(active.astype(jnp.int32)), 1)
     return jnp.minimum(rank * K // n_act, K - 1)
 

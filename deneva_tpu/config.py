@@ -185,6 +185,16 @@ class Config:
     tup_read_perc: float = 0.5        # TUP_READ_PERC (per-request read prob)
     txn_read_perc: float = 0.0        # TXN_READ_PERC (whole-txn read-only prob)
     zipf_theta: float = 0.6           # ZIPF_THETA
+    #: skew generator (SKEW_METHOD, config.h:219): "zipf" draws row ids
+    #: from the reference zeta/eta zipfian (ycsb_query.cpp:188-202);
+    #: "hot" is the reference's second generator (ycsb_query.cpp:205-301)
+    #: — ``access_perc`` of the traffic lands uniformly inside the
+    #: hottest ``data_perc`` fraction of the table, the rest uniformly in
+    #: the cold remainder.  The adversarial input for the adaptive
+    #: contention controller (hot set is a hard step, not a zipf tail).
+    skew_method: str = "zipf"
+    access_perc: float = 0.75         # ACCESS_PERC (hot-traffic fraction)
+    data_perc: float = 0.10           # DATA_PERC (hot-set table fraction)
     part_per_txn: int = 1             # PART_PER_TXN
     mpr: float = 1.0                  # MPR: multi-partition txn rate (config.h:197)
     first_part_local: bool = True     # FIRST_PART_LOCAL
@@ -395,6 +405,63 @@ class Config:
     #: rows of the hot-key report (obs/report.py; host-side only)
     heatmap_topk: int = 8
 
+    #: adaptive contention controller (deneva_tpu/ctrl/): close the loop
+    #: from the observatories back into the engine.  Three coupled
+    #: policies, every decision a pre-traced select/`lax.switch` path so
+    #: the steady state never recompiles as it adapts:
+    #:   (a) abort-reason-driven backoff — the single exponential
+    #:       schedule becomes a per-reason EWMA-tuned base/cap read from
+    #:       the abort taxonomy (lock kills restart cheap-and-fast,
+    #:       validation-family aborts pay a longer, jittered penalty);
+    #:   (b) hot-key escalation — heatmap buckets whose conflict EWMA
+    #:       crosses ``ctrl_esc_up`` promote a representative key into a
+    #:       per-key serialization ring: one WRITER per tick per
+    #:       escalated key (oldest ts wins; losers stall without
+    #:       aborting), an extra TRACED request mask under the
+    #:       2PL/TIMESTAMP plugins, with hysteresis (``ctrl_esc_down``)
+    #:       so cold keys pay nothing;
+    #:   (c) occupancy-driven width selection — live-occupancy EWMA
+    #:       picks a gear from a small static ladder of pre-traced
+    #:       ``plugin.access`` branches (wider ``compact_lanes`` /
+    #:       ``sub_ticks`` engagement under load; single-shard engine).
+    #: Controller state lives in the donated stats carry (``arr_ctrl_*``
+    #: planes + ``ctrl_*`` summary scalars).  Requires the taxonomy and
+    #: heatmap planes it reads.  Off by default — zero extra device
+    #: arrays and a byte-identical [summary] line for all plugins.
+    adaptive: bool = _optin(False, {"adaptive": True,
+                                    "abort_attribution": True,
+                                    "heatmap_bins": 16})
+    #: EWMA decay for every controller estimate: new = old + (x-old)>>shift
+    ctrl_ewma_shift: int = 3
+    #: backoff-base gain: per-reason base grows by 1 per 2^gain
+    #: EWMA-aborts/tick of that reason (policy a).  At gain 2 a cell
+    #: sustaining ~64 lock kills/tick drives the base into the
+    #: reference's winning ABORT_PENALTY=16 regime by itself
+    ctrl_gain_shift: int = 2
+    #: hard ceiling on any adaptive backoff penalty (ticks)
+    ctrl_backoff_max: int = 64
+    #: escalation ring slots — at most this many keys serialized at once
+    ctrl_esc_keys: int = 8
+    #: escalate a heatmap bucket above this conflict-EWMA (conflicts/tick)
+    ctrl_esc_up: int = 8
+    #: de-escalate below this (hysteresis: must be < ctrl_esc_up)
+    ctrl_esc_down: int = 2
+    #: dominance bar: escalate only a bucket carrying more than 1/share
+    #: of the WHOLE heatmap's conflict heat.  Broad zipf contention
+    #: spreads heat across buckets (no single key worth serializing —
+    #: backoff handles it); a tiny pathological hot set concentrates it
+    ctrl_esc_share: int = 8
+    #: overload release: never escalate — and release — a bucket whose
+    #: heat exceeds ctrl_esc_up * this factor.  The gate serves ONE
+    #: writer per tick, so a sustainable stall queue is a handful of
+    #: lanes; gate stalls feed the bucket's heat, so a gate that is
+    #: queueing instead of draining (broad zipf skew pointed at it)
+    #: trips this bound within a few ticks and releases itself
+    ctrl_esc_overload: int = 4
+    #: sub_ticks value the high-occupancy ladder gear engages (policy c;
+    #: only where Config.sub_ticks is legal for the plugin)
+    ctrl_sub_ticks: int = 2
+
     #: emit a ``[prog]`` heartbeat line every this-many ticks during
     #: Engine.run / ShardedEngine.run (the PROG_TIMER dump,
     #: system/thread.cpp:86-105; deneva_tpu/obs/prog.py).  Each emission
@@ -583,6 +650,25 @@ class Config:
         assert self.heatmap_bins >= 0 and \
             (self.heatmap_bins & (self.heatmap_bins - 1)) == 0, \
             "heatmap_bins must be 0 or a power of two"
+        assert self.skew_method in ("zipf", "hot"), self.skew_method
+        if self.skew_method == "hot":
+            assert 0.0 <= self.access_perc <= 1.0, self.access_perc
+            assert 0.0 < self.data_perc <= 1.0, self.data_perc
+        if self.adaptive:
+            # the controller is fed by the taxonomy + heatmap planes;
+            # running it blind would silently adapt on zeros
+            assert self.abort_attribution, \
+                "adaptive reads the per-reason abort taxonomy"
+            assert self.heatmap_bins > 0, \
+                "adaptive reads the conflict heatmap"
+            assert self.ctrl_ewma_shift >= 0 and self.ctrl_gain_shift >= 0
+            assert self.ctrl_backoff_max >= 1 and self.ctrl_esc_keys > 0
+            assert 0 <= self.ctrl_esc_down < self.ctrl_esc_up, \
+                "escalation hysteresis needs ctrl_esc_down < ctrl_esc_up"
+            assert self.ctrl_esc_share >= 1
+            assert self.ctrl_esc_overload >= 2, \
+                "overload bound must sit above the escalation threshold"
+            assert self.ctrl_sub_ticks >= 2
         if self.faults:
             assert self.node_cnt > 1, \
                 "faults need a multi-node topology (sharded engine)"

@@ -1,0 +1,12 @@
+"""Adaptive contention controller (Config.adaptive).
+
+Closes the loop from the observability planes (abort taxonomy, conflict
+heatmap, live occupancy) back into the engine at runtime — the Deneva
+study's core finding (contention dominates protocol choice) turned from
+measurement into mechanism.  See ctrl/controller.py for the three
+policies and their invariants.
+"""
+
+from deneva_tpu.ctrl.controller import (  # noqa: F401
+    CTRL_SCALE, esc_stall, init_ctrl, note_stall_heat, penalty, update,
+    width_ladder, zero_tick_planes)

@@ -1,0 +1,432 @@
+"""Adaptive contention controller — on-device, jit-safe (Config.adaptive).
+
+Three coupled policies, each fed by an observability plane the engine
+already carries, each a pre-traced select/`lax.switch` path (the steady
+state NEVER recompiles as the controller adapts — the xmeter sentinel
+proves it in scripts/check.sh):
+
+(a) **abort-reason-driven backoff** (`penalty`): the single exponential
+    schedule (scheduler `_penalty`) becomes a per-reason base tuned by an
+    EWMA of that reason's abort rate (`arr_ctrl_reason_ewma`, fed by the
+    note_aborts taxonomy sites).  Lock-family kills (NO_WAIT conflict,
+    WAIT_DIE wound, T/O too-old) start cheap but COMPOUND exponentially
+    in restarts — a lock kill costs almost nothing, so the right response
+    to sustained pressure is draining the over-saturated batch (the
+    static sweep's p16 regime).  Backoff thrash (died the tick it woke)
+    rides the compounding schedule under lock/T-O plugins — it is the
+    direct evidence the previous penalty was too short — and stays flat
+    under validation plugins (see _class_tables).  Validation-family
+    aborts (OCC/MAAT) are the opposite: the txn burned a full execution
+    already,
+    compounding its penalty starves throughput (and collapses MAAT
+    timestamp ranges), so their penalty stays FLAT and small, with a
+    per-lane deterministic jitter that desynchronizes retry storms (a
+    batch of vaborted txns with equal penalties re-collides wholesale
+    every period; jitter spreads them).
+
+(b) **hot-key escalation** (`esc_stall` + the ring maintained in
+    `update`): heatmap buckets whose conflict-rate EWMA crosses
+    ``ctrl_esc_up`` AND carries a dominant share (> 1/``ctrl_esc_share``)
+    of the whole heatmap's heat promote their representative key into a
+    small serialization ring; while a key is escalated, at most ONE
+    writer per tick OPENS on it (oldest timestamp wins; losing lanes at
+    cursor 0 — holding no locks yet, so the stall has no side effects —
+    simply make no request this tick; mid-txn lanes are never stalled:
+    freezing their held locks would wedge the rest of the table).
+    Aborting and restarting a doomed writer costs a full backoff +
+    re-execution; stalling it costs one tick.  Gate stalls
+    feed back into the bucket's conflict plane (`note_stall_heat`), so a
+    productively-gated key stays escalated instead of thrashing the
+    hysteresis; a key too hot for one writer/tick to drain crosses the
+    ``ctrl_esc_up * ctrl_esc_overload`` bound and is released (or never
+    taken on) — broad zipf-style contention is backoff's job, not the
+    gate's.  De-escalation below ``ctrl_esc_down`` (hysteresis) makes
+    cold keys free again.  Only plugins that declare ``esc_gate_ok``
+    (2PL family + TIMESTAMP) take the gate: their held-lock/prewrite
+    state makes "stall without deciding" safe and meaningful.
+
+    Progress: a lane stalls only while a strictly-older live txn targets
+    the same escalated key this tick.  Following that "older" edge
+    strictly decreases ts, so every stall chain ends at a txn that takes
+    the normal arbitration path — the gate can delay, never deadlock.
+
+(c) **occupancy-driven width selection** (`width_ladder` + the gear
+    chosen in `update`): a slot-occupancy EWMA (in-flight lanes,
+    backoff sleepers included — a batch full of them IS the contended
+    regime) picks one gear from a
+    small static ladder of pre-traced ``plugin.access`` variants —
+    wider ``compact_lanes`` (spill retries hurt exactly when occupancy
+    is high) and ``sub_ticks`` engagement (within-tick lock handoff
+    pays off under contention) — via ``lax.switch`` over branches XLA
+    compiled once.  Single-shard engine only: the sharded owner tick
+    pins its virtual-entry geometry per node.
+
+State lives in the donated stats carry: ``arr_ctrl_*`` planes (excluded
+from [summary] by prefix) plus ``ctrl_*`` 0-d scalars that surface in
+[summary] and round-trip through stats.parse_summary.  Everything is
+int32 fixed-point (values scaled by 2**CTRL_SCALE) — no floats, no
+widening, donation-friendly.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from deneva_tpu.cc import base as cc_base
+from deneva_tpu.config import Config
+from deneva_tpu.engine.state import BIG_TS, NULL_KEY, STATUS_FREE, TxnState
+
+#: fixed-point shift for every controller EWMA (value << CTRL_SCALE)
+CTRL_SCALE = 4
+
+#: lock/T-O kills die before doing work — the kill is cheap, so the base
+#: starts at 1; but the cure for SUSTAINED lock pressure is draining the
+#: batch, so this class keeps the exponential-in-restarts growth up to
+#: the hard ceiling (the static sweep's winning p16 regime lives inside
+#: it)
+_FAST_REASONS = ("nowait_conflict", "waitdie_wound", "ts_too_old_read",
+                 "ts_too_old_write", "mvcc_version_miss")
+#: validation-family aborts burned a whole execution before dying —
+#: compounding their penalty starves throughput (and for MAAT collapses
+#: the surviving timestamp ranges), so this class is FLAT: no restart
+#: growth, a tiny EWMA-tuned base, and the per-lane jitter that spreads
+#: the re-colliding vabort cohort.  backoff_reabort is classified per
+#: algorithm in _class_tables: it follows the plugin's dominant abort
+#: family.
+_SLOW_REASONS = ("occ_validation", "maat_range_collapse")
+
+
+def _class_tables(cfg: Config):
+    """Static per-reason (min base, cap, flat+jittered) tables, indexed
+    by reason code - 1 (aligned with cc_base.ABORT_REASONS).  Reasons in
+    neither class (user/capacity artifacts) retry near-immediately.
+
+    backoff_reabort (died the very tick it woke) follows the plugin's
+    dominant abort family, a static trace-time property: under lock/T-O
+    algorithms it is lock pressure and the direct evidence the previous
+    penalty was too short, so it compounds with the fast class; under
+    validation plugins (``vabort_reason`` set) wake-tick thrash is
+    validation thrash, and compounding it starves the pipeline the same
+    way compounding vaborts does, so it stays flat."""
+    from deneva_tpu import cc as cc_registry
+    n = len(cc_base.ABORT_REASONS)
+    mins = np.ones(n, np.int32)
+    caps = np.full(n, min(4, cfg.ctrl_backoff_max), np.int32)
+    flat = np.zeros(n, bool)
+    for nm in _FAST_REASONS:
+        caps[cc_base.REASON[nm] - 1] = cfg.ctrl_backoff_max
+    for nm in _SLOW_REASONS:
+        # cap 2, not 4: the flat class's lever is jittered desync, and
+        # the reference's constant-1 (NO_BACKOFF) regime wins for the
+        # validation family — a base above ~2 only delays commits
+        i = cc_base.REASON[nm] - 1
+        caps[i] = min(2, cfg.ctrl_backoff_max)
+        flat[i] = True
+    i = cc_base.REASON["backoff_reabort"] - 1
+    if cc_registry.get(cfg.cc_alg).vabort_reason is None:
+        caps[i] = cfg.ctrl_backoff_max
+    else:
+        caps[i] = min(2, cfg.ctrl_backoff_max)
+        flat[i] = True
+    return mins, caps, flat
+
+
+def _bases(cfg: Config, ewma):
+    """Per-reason backoff base from the abort-rate EWMA: grows by one
+    tick per 2**ctrl_gain_shift aborts/tick of that reason, clipped into
+    the reason's static [min, cap] class band.  The flat (validation)
+    class takes a 4x weaker gain — its lever is jittered desync, not
+    delay, so its base should leave 1 only under real thrash.
+    Self-regulating: a long base drains the in-flight set, the abort
+    rate falls, the EWMA decays and the base follows it back down."""
+    mins, caps, flat = _class_tables(cfg)
+    grow = ewma >> (CTRL_SCALE + cfg.ctrl_gain_shift)
+    grow = jnp.where(jnp.asarray(flat), grow >> 2, grow)
+    return jnp.clip(jnp.asarray(mins) + grow, jnp.asarray(mins),
+                    jnp.asarray(caps))
+
+
+def init_ctrl(cfg: Config) -> dict:
+    """Controller carry block, merged into the engine stats dict by
+    _zeros_stats (both engines).  ``arr_ctrl_*`` planes stay out of
+    [summary]; the 0-d ``ctrl_*`` scalars surface automatically."""
+    n = len(cc_base.ABORT_REASONS)
+    s = {
+        # per-tick inputs, zeroed at tick start (zero_tick_planes) and
+        # filled at the existing taxonomy/heatmap emission sites
+        "arr_ctrl_reason_tick": jnp.zeros(n, jnp.int32),
+        "arr_ctrl_conf_tick": jnp.zeros(cfg.heatmap_bins, jnp.int32),
+        "arr_ctrl_bit_tick": jnp.zeros((cfg.heatmap_bins, 31), jnp.int32),
+        # EWMAs (int32 fixed-point, << CTRL_SCALE)
+        "arr_ctrl_reason_ewma": jnp.zeros(n, jnp.int32),
+        "arr_ctrl_heat": jnp.zeros(cfg.heatmap_bins, jnp.int32),
+        # per-bucket bitwise key majority (the heavy-hitter estimator
+        # behind escalation): EWMA of each key bit over the bucket's
+        # conflict events.  When one key dominates its bucket — the
+        # regime escalation targets — the majority bit pattern IS that
+        # key; `update` re-hashes it as a validity check, so collision
+        # noise can only suppress an escalation, never aim it wrong.
+        "arr_ctrl_bit_ewma": jnp.zeros((cfg.heatmap_bins, 31), jnp.int32),
+        # escalation ring: key + the heatmap bucket it came from
+        "arr_ctrl_esc_key": jnp.full(cfg.ctrl_esc_keys, NULL_KEY,
+                                     jnp.int32),
+        "arr_ctrl_esc_bucket": jnp.full(cfg.ctrl_esc_keys, -1, jnp.int32),
+        # summary scalars (gauges refreshed per tick + decision counters;
+        # a controller surface like the heatmap: not warmup-gated)
+        "ctrl_occ_ewma": jnp.zeros((), jnp.int32),
+        "ctrl_width_idx": jnp.zeros((), jnp.int32),
+        "ctrl_esc_active": jnp.zeros((), jnp.int32),
+        "ctrl_escalate_cnt": jnp.zeros((), jnp.int32),
+        "ctrl_deescalate_cnt": jnp.zeros((), jnp.int32),
+        "ctrl_width_step_cnt": jnp.zeros((), jnp.int32),
+        "ctrl_esc_block_cnt": jnp.zeros((), jnp.int32),
+    }
+    for name in cc_base.ABORT_REASONS:
+        s[f"ctrl_base_{name}"] = jnp.zeros((), jnp.int32)
+    return s
+
+
+def zero_tick_planes(stats: dict) -> dict:
+    """Reset the controller's per-tick input planes (tick start)."""
+    return {**stats,
+            "arr_ctrl_reason_tick":
+                jnp.zeros_like(stats["arr_ctrl_reason_tick"]),
+            "arr_ctrl_conf_tick":
+                jnp.zeros_like(stats["arr_ctrl_conf_tick"]),
+            "arr_ctrl_bit_tick":
+                jnp.zeros_like(stats["arr_ctrl_bit_tick"])}
+
+
+def penalty(cfg: Config, stats: dict, restarts, code_b, t):
+    """(B,) adaptive backoff penalty — policy (a).
+
+    Replaces scheduler ``_penalty`` when Config.adaptive: per-reason
+    EWMA-tuned base; the lock-kill class keeps the exponential-in-
+    restarts growth clipped to its cap, while the flat validation class
+    never compounds.  Every class then takes a deterministic
+    per-(lane, tick) jitter proportional to its penalty: lanes killed
+    the same tick wake the same tick and re-collide wholesale, and
+    spreading each cohort over [pen, 1.5*pen] breaks that resonance —
+    the one lever the static ladder structurally lacks.  ``code_b`` is
+    the lane's abort reason this tick (0 / unregistered falls back to
+    "other"); lanes that are not aborting get an arbitrary value the
+    caller masks away."""
+    n = len(cc_base.ABORT_REASONS)
+    _, caps_np, flat_np = _class_tables(cfg)
+    base = _bases(cfg, stats["arr_ctrl_reason_ewma"])
+    code = jnp.where(code_b <= 0, jnp.int32(cc_base.REASON["other"]),
+                     jnp.minimum(code_b, jnp.int32(n)))
+    ci = code - 1
+    is_flat = jnp.asarray(flat_np)[ci]
+    shift = jnp.where(is_flat, 0, jnp.minimum(restarts, 6))
+    pen = jnp.minimum(base[ci] << shift, jnp.asarray(caps_np)[ci])
+    # retry-storm desync: hash(lane, tick) in [0, pen/2 + 1] — the +2
+    # window keeps even a base-1 cohort split across two ticks
+    lane = jnp.arange(restarts.shape[0], dtype=jnp.uint32)
+    h = (lane * jnp.uint32(0x9E3779B1)
+         ^ (t.astype(jnp.uint32) + jnp.uint32(1)) * jnp.uint32(0x85EBCA6B))
+    jit = (h % (pen.astype(jnp.uint32) // 2 + 2)).astype(jnp.int32)
+    pen = pen + jit
+    return jnp.maximum(pen, 1).astype(jnp.int32)
+
+
+def esc_stall(cfg: Config, stats: dict, txn: TxnState, active):
+    """(B,) mask — policy (b)'s one-writer-per-tick gate.
+
+    A lane stalls iff its FIRST access (cursor 0 — it holds nothing yet)
+    is a write to an escalated key and a strictly older live txn is
+    writing the same key this tick.  The caller empties the stalled
+    lanes' request window (clamps n_req to the cursor) so every plugin
+    path sees no request: no grant, no wait, no abort — a clean one-tick
+    stall.  The cursor-0 restriction is load-bearing: a mid-txn lane
+    holds locks, and stalling it would extend every held lock's hold
+    time for the whole multi-tick stall — under broad skew the stalled
+    hot-key writers' footprints poison the rest of the table and the
+    batch wedges.  A lock-free lane's stall is genuinely free."""
+    ring = stats["arr_ctrl_esc_key"]                        # (E,)
+    B, R = txn.keys.shape
+    ridx = jnp.arange(R, dtype=jnp.int32)[None, :]
+    m = ridx == jnp.clip(txn.cursor, 0, R - 1)[:, None]
+    cur_key = jnp.sum(jnp.where(m, txn.keys, 0), axis=1)
+    cur_w = jnp.any(m & txn.is_write, axis=1)
+    cand = active & (txn.cursor == 0) & (txn.n_req > 0) & cur_w
+    match = (cand[:, None] & (cur_key[:, None] == ring[None, :])
+             & (ring != NULL_KEY)[None, :])                 # (B, E)
+    # oldest writer per escalated key wins (ts unique across live txns)
+    win_ts = jnp.min(jnp.where(match, txn.ts[:, None], BIG_TS), axis=0)
+    return jnp.any(match & (txn.ts[:, None] > win_ts[None, :]), axis=1)
+
+
+def note_stall_heat(cfg: Config, stats: dict, txn: TxnState, stall):
+    """Feed this tick's gate stalls back into the controller's conflict
+    plane — policy (b)'s stabilizer.
+
+    A stalled writer is a conflict the gate absorbed: without this
+    feedback the gated bucket cools (stalls raise no aborts), the
+    hysteresis releases it, the retry storm returns and the controller
+    thrashes escalate/de-escalate.  Counting stalls as bucket heat keeps
+    a productively-gated key escalated — and lets a gate that is
+    QUEUEING rather than draining (arrivals far above one writer/tick)
+    heat its bucket past the overload bound in `update`, releasing
+    itself.  Controller plane only: the user-facing heatmap keeps
+    counting real CC friction."""
+    B, R = txn.keys.shape
+    ridx = jnp.arange(R, dtype=jnp.int32)[None, :]
+    m = ridx == jnp.clip(txn.cursor, 0, R - 1)[:, None]
+    key_b = jnp.sum(jnp.where(m, txn.keys, 0), axis=1)
+    bins = cfg.heatmap_bins
+    log2 = bins.bit_length() - 1
+    if log2 == 0:
+        hidx = jnp.zeros_like(key_b)
+    else:
+        hidx = ((key_b.astype(jnp.uint32) * jnp.uint32(2654435761))
+                >> jnp.uint32(32 - log2)).astype(jnp.int32)
+    idx = jnp.where(stall, hidx, bins)
+    bits = ((key_b[:, None] >> jnp.arange(31, dtype=jnp.int32))
+            & 1).astype(jnp.int32)
+    return {**stats,
+            "arr_ctrl_conf_tick":
+                stats["arr_ctrl_conf_tick"].at[idx].add(1, mode="drop"),
+            "arr_ctrl_bit_tick":
+                stats["arr_ctrl_bit_tick"].at[idx].add(bits, mode="drop")}
+
+
+def update(cfg: Config, stats: dict, status, ladder_len: int) -> dict:
+    """End-of-tick controller step: fold this tick's observations into
+    the EWMAs and re-decide all three policies.  Pure jnp — selects,
+    clips, one tiny argmax/argmin pair over the heatmap/ring widths."""
+    sh = cfg.ctrl_ewma_shift
+    bins = cfg.heatmap_bins
+    out = dict(stats)
+
+    # ---- (a) per-reason abort-rate EWMA -> published backoff bases ----
+    ewma = stats["arr_ctrl_reason_ewma"]
+    ewma = ewma + (((stats["arr_ctrl_reason_tick"] << CTRL_SCALE) - ewma)
+                   >> sh)
+    out["arr_ctrl_reason_ewma"] = ewma
+    base = _bases(cfg, ewma)
+    for i, name in enumerate(cc_base.ABORT_REASONS):
+        out[f"ctrl_base_{name}"] = base[i]
+
+    # ---- (b) bucket heat EWMA -> escalation ring (with hysteresis) ----
+    heat = stats["arr_ctrl_heat"]
+    heat = heat + (((stats["arr_ctrl_conf_tick"] << CTRL_SCALE) - heat)
+                   >> sh)
+    out["arr_ctrl_heat"] = heat
+    bit = stats["arr_ctrl_bit_ewma"]
+    bit = bit + (((stats["arr_ctrl_bit_tick"] << CTRL_SCALE) - bit) >> sh)
+    out["arr_ctrl_bit_ewma"] = bit
+    # heavy-hitter per bucket: a bit is set in the majority key iff it is
+    # set in more than half the bucket's (EWMA-weighted) conflicts; the
+    # re-hash check below rejects patterns that aren't a key of this
+    # bucket (no single dominant key => usually fails => no escalation)
+    maj_key = jnp.sum(jnp.where(2 * bit > heat[:, None],
+                                jnp.int32(1) << jnp.arange(31,
+                                                           dtype=jnp.int32),
+                                0), axis=1)                      # (bins,)
+
+    key = stats["arr_ctrl_esc_key"]
+    bucket = stats["arr_ctrl_esc_bucket"]
+    up = jnp.int32(cfg.ctrl_esc_up << CTRL_SCALE)
+    down = jnp.int32(cfg.ctrl_esc_down << CTRL_SCALE)
+    over = jnp.int32((cfg.ctrl_esc_up * cfg.ctrl_esc_overload)
+                     << CTRL_SCALE)
+    slot_heat = jnp.where(bucket >= 0, heat[jnp.clip(bucket, 0, bins - 1)],
+                          0)
+    # release a slot that went cold (hysteresis) OR blew past the
+    # overload bound: gate stalls feed back into the conflict plane
+    # (note_stall_heat), so a gate that is queueing rather than draining
+    # — per-key arrivals far above its one-writer-per-tick service rate —
+    # heats its own bucket until this releases it
+    cold = (key != NULL_KEY) & ((slot_heat < down) | (slot_heat >= over))
+    n_de = jnp.sum(cold.astype(jnp.int32))
+    key = jnp.where(cold, NULL_KEY, key)
+    bucket = jnp.where(cold, -1, bucket)
+    slot_heat = jnp.where(cold, 0, slot_heat)
+
+    # escalate the hottest not-yet-escalated bucket into the weakest slot
+    # (at most one promotion per tick — adaptation is deliberately slow
+    # next to the tick rate, and the trace ring shows every step)
+    bidx = jnp.arange(bins, dtype=jnp.int32)
+    already = jnp.any(bidx[:, None] == bucket[None, :], axis=1)  # (bins,)
+    cand = jnp.argmax(jnp.where(already, jnp.int32(-1), heat)
+                      ).astype(jnp.int32)
+    cand_heat = heat[cand]
+    cand_key = maj_key[cand]
+    log2 = bins.bit_length() - 1
+    if log2 == 0:
+        key_ok = cand_key > 0
+    else:
+        rehash = ((cand_key.astype(jnp.uint32) * jnp.uint32(2654435761))
+                  >> jnp.uint32(32 - log2)).astype(jnp.int32)
+        key_ok = (cand_key > 0) & (rehash == cand)
+    empty = key == NULL_KEY
+    score = jnp.where(empty, jnp.int32(-1), slot_heat)
+    victim = jnp.argmin(score).astype(jnp.int32)
+    # dominance: only a bucket carrying more than 1/ctrl_esc_share of the
+    # WHOLE heatmap's conflict heat is worth serializing.  Broad zipf
+    # contention spreads heat across buckets (no single key dominates —
+    # backoff, not the gate, is the right tool); a tiny pathological hot
+    # set concentrates it.  The overload bound mirrors the release rule:
+    # a key too hot for one writer/tick is never taken on.
+    dominant = cand_heat > jnp.sum(heat) // jnp.int32(cfg.ctrl_esc_share)
+    do = ((cand_heat >= up) & (cand_heat < over) & dominant & key_ok
+          & ~already[cand] & (cand_heat > score[victim]))
+    # scalar victim index: a single slot is duplicate-free by construction
+    key = key.at[victim].set(jnp.where(do, cand_key, key[victim]),
+                             unique_indices=True)
+    bucket = bucket.at[victim].set(jnp.where(do, cand, bucket[victim]),
+                                   unique_indices=True)
+    out["arr_ctrl_esc_key"] = key
+    out["arr_ctrl_esc_bucket"] = bucket
+    out["ctrl_escalate_cnt"] = (stats["ctrl_escalate_cnt"]
+                                + do.astype(jnp.int32))
+    out["ctrl_deescalate_cnt"] = stats["ctrl_deescalate_cnt"] + n_de
+    out["ctrl_esc_active"] = jnp.sum((key != NULL_KEY).astype(jnp.int32))
+
+    # ---- (c) slot-occupancy EWMA -> ladder gear ----
+    # occupancy = in-flight slots (everything not FREE, backoff sleepers
+    # included): a batch full of backing-off lanes IS congestion — the
+    # contended regime where the wider gear pays — even though few lanes
+    # are RUNNING at any instant
+    occ = jnp.sum((status != STATUS_FREE).astype(jnp.int32))
+    oe = stats["ctrl_occ_ewma"]
+    oe = oe + (((occ << CTRL_SCALE) - oe) >> sh)
+    out["ctrl_occ_ewma"] = oe
+    B = status.shape[0]
+    idx = jnp.zeros((), jnp.int32)
+    for k in range(ladder_len - 1):
+        # gear k+1 engages above occupancy B*(k+1)/ladder_len
+        thr = jnp.int32((B * (k + 1) // ladder_len) << CTRL_SCALE)
+        idx = idx + (oe > thr).astype(jnp.int32)
+    out["ctrl_width_idx"] = idx
+    out["ctrl_width_step_cnt"] = (stats["ctrl_width_step_cnt"]
+                                  + (idx != stats["ctrl_width_idx"]
+                                     ).astype(jnp.int32))
+    return out
+
+
+def width_ladder(cfg: Config, plugin) -> list:
+    """Static gear ladder for policy (c): index 0 is the exact configured
+    behavior; higher gears trade work for contention tolerance.  Gears
+    exist only where legal for (cfg, plugin) — an ineligible cell gets a
+    one-entry ladder and the scheduler skips the switch entirely."""
+    if not cfg.adaptive:
+        return [cfg]
+    from deneva_tpu.config import READ_COMMITTED, SERIALIZABLE
+    ladder = [cfg]
+    if cfg.entry_compaction and cfg.compact_lanes is not None:
+        # high occupancy = more live entries = compaction spill retries;
+        # widen the bucket under load (compact_width clamps to B*R)
+        ladder.append(cfg.replace(compact_lanes=cfg.compact_lanes * 2))
+    sub_ok = (cfg.sub_ticks == 1 and cfg.acquire_window == 1
+              and plugin.name in ("NO_WAIT", "WAIT_DIE", "TIMESTAMP")
+              and (plugin.name == "TIMESTAMP"
+                   or cfg.isolation_level in (SERIALIZABLE,
+                                              READ_COMMITTED)))
+    if sub_ok:
+        # within-tick lock handoff: worth its extra sub-rounds exactly
+        # when the batch is full of conflicting lanes
+        ladder.append(cfg.replace(sub_ticks=cfg.ctrl_sub_ticks))
+    return ladder

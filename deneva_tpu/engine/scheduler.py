@@ -30,6 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deneva_tpu import cc as cc_registry
+from deneva_tpu import ctrl
 from deneva_tpu import workloads as wl_registry
 from deneva_tpu.cc import base as cc_base
 from deneva_tpu.config import Config
@@ -147,6 +148,12 @@ def _zeros_stats(cfg: Config | None = None,
         s["arr_part_conflict"] = jnp.zeros(cfg.part_cnt, jnp.int32)
         s["arr_wait_streak"] = jnp.zeros(cfg.batch_size, jnp.int32)
         s["arr_wait_depth_hist"] = jnp.zeros(WAIT_DEPTH_BINS, jnp.int32)
+    if cfg is not None and cfg.adaptive:
+        # adaptive contention controller carry (deneva_tpu/ctrl/): EWMA
+        # planes + escalation ring + [summary] decision gauges/counters.
+        # Off ⇒ zero extra device arrays (the off-path identity cell in
+        # scripts/check.sh holds the [summary] bytes to it).
+        s.update(ctrl.init_ctrl(cfg))
     if cfg is not None:
         # per-tick timeline ring (obs/trace.py); {} when trace_ticks == 0
         s.update(obs_trace.init_trace(cfg, LAT_SAMPLES))
@@ -285,6 +292,12 @@ def note_aborts(cfg: Config, stats: dict, code_b, mask_b,
     if "arr_reason_tick" in stats:
         stats = {**stats,
                  "arr_reason_tick": stats["arr_reason_tick"] + hist}
+    if "arr_ctrl_reason_tick" in stats:
+        # controller input (ctrl policy a): same event sites and masks as
+        # the taxonomy counters, but per-tick and never warmup-gated —
+        # the backoff EWMAs must see warmup contention too
+        stats = {**stats, "arr_ctrl_reason_tick":
+                 stats["arr_ctrl_reason_tick"] + hist}
     if t is not None:
         stats = obs_flight.record_events(stats, code_b, mask_b, t, key_b)
     return stats
@@ -334,6 +347,23 @@ def note_conflicts(cfg: Config, stats: dict, conflict_b, key_b,
     # the tick after the last park) — see WAIT_DEPTH_BINS
     ended = (streak > 0) & ~wait_b
     depth = jnp.minimum(streak, WAIT_DEPTH_BINS - 1)
+    if "arr_ctrl_conf_tick" in stats:
+        # controller input (ctrl policy b): this tick's per-bucket
+        # conflict counts plus the per-bit key decomposition behind the
+        # bucket's heavy-hitter majority, same hash/mask as the
+        # cumulative heatmap.  Gate-stalled lanes are not in conflict_b
+        # (a stall is not CC friction) — the gate site feeds them into
+        # this plane separately (ctrl.note_stall_heat), so a gated
+        # bucket neither cools into hysteresis thrash nor hides the
+        # overload signal.
+        bits = ((key_b[:, None] >> jnp.arange(31, dtype=jnp.int32))
+                & 1).astype(jnp.int32)
+        stats = {**stats,
+                 "arr_ctrl_conf_tick":
+                 stats["arr_ctrl_conf_tick"].at[idx].add(1, mode="drop"),
+                 "arr_ctrl_bit_tick":
+                 stats["arr_ctrl_bit_tick"].at[idx].add(bits,
+                                                        mode="drop")}
     return {**stats,
             "arr_conflict_hist": stats["arr_conflict_hist"].at[idx].add(
                 1, mode="drop"),
@@ -465,6 +495,11 @@ def make_tick(cfg: Config, plugin, pool_dev: dict, workload=None):
                             if plugin.vabort_reason
                             else cc_base.REASON["other"])
     ua_code = jnp.int32(cc_base.REASON["user_abort"])
+    # adaptive width ladder (ctrl policy c): a static list of legal
+    # plugin.access variants for this (cfg, plugin) cell; [cfg] when
+    # adaptive is off or no wider gear is legal.  Every gear is traced
+    # once into the lax.switch below — gear changes never recompile.
+    ladder = ctrl.width_ladder(cfg, plugin)
 
     # jitted via jax.jit(self._tick_fn) -- an attribute reference the
     # static seed scan cannot see, hence the explicit marker:
@@ -483,6 +518,10 @@ def make_tick(cfg: Config, plugin, pool_dev: dict, workload=None):
             # note_aborts and recorded into the reason-trace ring below
             stats = {**stats, "arr_reason_tick":
                      jnp.zeros_like(stats["arr_reason_tick"])}
+        if cfg.adaptive:
+            # controller per-tick input planes restart from zero; the
+            # EWMAs and the escalation ring carry across ticks
+            stats = ctrl.zero_tick_planes(stats)
 
         # ---- 1. backoff expiry: restart aborted txns ----
         expire = (txn.status == STATUS_BACKOFF) & (txn.backoff_until <= t)
@@ -704,7 +743,39 @@ def make_tick(cfg: Config, plugin, pool_dev: dict, workload=None):
                 acc_txn = txn._replace(
                     is_write=txn.is_write & ~shadow[:, None])
             if normal:
-                dec, db = plugin.access(cfg, db, acc_txn, acc_active)
+                if cfg.adaptive and plugin.esc_gate_ok:
+                    # hot-key serialization gate (ctrl policy b): lanes
+                    # that lose the oldest-writer race on an escalated key
+                    # get an EMPTY request window this tick — n_req is
+                    # clamped to the cursor on the plugin's view ONLY, so
+                    # no plugin path grants/waits/aborts them and held
+                    # locks stay held; the cursor-advance below still uses
+                    # the original txn.n_req, so the lane just stalls one
+                    # tick and retries when the winner has moved on.
+                    stall = ctrl.esc_stall(cfg, stats, txn, active)
+                    stats = {**stats, "ctrl_esc_block_cnt":
+                             stats["ctrl_esc_block_cnt"]
+                             + jnp.sum(stall.astype(jnp.int32))}
+                    # stalls are absorbed conflicts: keep the gated
+                    # bucket hot (no hysteresis thrash) and let a
+                    # starving gate trip the overload release
+                    stats = ctrl.note_stall_heat(cfg, stats, txn, stall)
+                    acc_txn = acc_txn._replace(n_req=jnp.where(
+                        stall, jnp.minimum(acc_txn.cursor, acc_txn.n_req),
+                        acc_txn.n_req))
+                if len(ladder) > 1:
+                    # ctrl policy (c): all gears traced up front; the
+                    # occupancy EWMA picks one per tick via lax.switch
+                    branches = [
+                        (lambda op, c=c: plugin.access(c, op[0], op[1],
+                                                       op[2]))
+                        for c in ladder]
+                    dec, db = jax.lax.switch(
+                        jnp.clip(stats["ctrl_width_idx"], 0,
+                                 len(ladder) - 1),
+                        branches, (db, acc_txn, acc_active))
+                else:
+                    dec, db = plugin.access(cfg, db, acc_txn, acc_active)
             else:
                 from deneva_tpu.cc.base import AccessDecision
                 reqm = (active[:, None] & (ridx >= txn.cursor[:, None])
@@ -773,7 +844,12 @@ def make_tick(cfg: Config, plugin, pool_dev: dict, workload=None):
             if cfg.heatmap_bins > 0:
                 stats = note_conflicts(cfg, stats, wait | acc_fail,
                                        fail_key, wait)
-            penalty = _penalty(txn.restarts)
+            if cfg.adaptive:
+                # ctrl policy (a): per-reason EWMA-tuned backoff schedule
+                # (adaptive implies abort_attribution, so code_b exists)
+                penalty = ctrl.penalty(cfg, stats, txn.restarts, code_b, t)
+            else:
+                penalty = _penalty(txn.restarts)
             status = jnp.where(abort_now, STATUS_BACKOFF, status)
             cursor = jnp.where(abort_now, 0, cursor)
             backoff_base = txn.backoff_until
@@ -827,12 +903,24 @@ def make_tick(cfg: Config, plugin, pool_dev: dict, workload=None):
             txn = txn._replace(
                 status=jnp.where(vabort, STATUS_BACKOFF, txn.status),
                 cursor=jnp.where(vabort, 0, txn.cursor),
-                backoff_until=jnp.where(vabort,
-                                        t + _penalty(txn.restarts),
-                                        txn.backoff_until),
+                backoff_until=jnp.where(
+                    vabort,
+                    t + (ctrl.penalty(cfg, stats, txn.restarts,
+                                      jnp.full((txn.B,), vabort_code,
+                                               jnp.int32), t)
+                         if cfg.adaptive else _penalty(txn.restarts)),
+                    txn.backoff_until),
                 restarts=jnp.where(vabort, txn.restarts + 1, txn.restarts))
             db = plugin.on_abort(cfg, db, txn, abort_now | vabort | ua) \
                 if normal else db
+
+        if cfg.adaptive:
+            # controller step: fold this tick's reason histogram, bucket
+            # conflicts and live occupancy into the EWMAs, then re-decide
+            # backoff bases / escalation ring / width gear for the NEXT
+            # tick.  Pure selects over the carried planes — adapting
+            # never retraces (the xmeter smoke stage proves it).
+            stats = ctrl.update(cfg, stats, txn.status, len(ladder))
 
         # latency decomposition integrals: txn-ticks per end-of-tick state
         stats = track_state_latencies(stats, txn, measuring)
@@ -854,6 +942,7 @@ def make_tick(cfg: Config, plugin, pool_dev: dict, workload=None):
                 live_entries=live_delta, compact_ovf=ovf_delta)
             stats = obs_trace.record_reasons(stats, t)
             stats = obs_trace.record_queue(stats, t)
+            stats = obs_trace.record_ctrl(stats, t)
 
         # ts wraparound guard: only relative order matters, and every live
         # txn's ts lies within [ts_counter - horizon, ts_counter], so rebase

@@ -67,6 +67,9 @@ def record_events(rec: dict, pid_base: int = 0, tick_us: float = 1.0,
     mesh_names = sorted((k for k in timeline
                          if k.startswith("mesh_tx_to")),
                         key=lambda k: int(k[len("mesh_tx_to"):]))
+    # adaptive-controller decision series (obs/trace.py CTRL_COLUMNS,
+    # present only for Config.adaptive runs with a trace ring)
+    ctrl_names = sorted(k for k in timeline if k.startswith("ctrl_"))
     for node in range(n_nodes):
         pid = pid_base + node
         pname = label or "engine"
@@ -88,7 +91,8 @@ def record_events(rec: dict, pid_base: int = 0, tick_us: float = 1.0,
                                         for c in series}})
         for t_name, cols in (("abort reasons", reason_names),
                              ("admission queue", ("queue_depth",)),
-                             ("mesh traffic", mesh_names)):
+                             ("mesh traffic", mesh_names),
+                             ("controller decisions", ctrl_names)):
             series = {c: _series(timeline, c, node, n_nodes)
                       for c in cols}
             series = {c: s for c, s in series.items() if s is not None}

@@ -131,6 +131,17 @@ def _entry(source: str, order: tuple, doc: dict) -> Optional[dict]:
             except (TypeError, ValueError):
                 continue
     out["scaling_amp"] = amp
+    # adaptive-controller sweep records (bench.py --adaptive) carry one
+    # adaptive-over-best-static commits/tick ratio per (alg, contention)
+    # cell; same normalize-to-empty discipline, so the floor self-arms
+    # on the first recorded sweep
+    avs = {}
+    for cell_key, v in (doc.get("adaptive_vs_static") or {}).items():
+        try:
+            avs[cell_key] = float(v)
+        except (TypeError, ValueError):
+            continue
+    out["adaptive_vs_static"] = avs
     return out
 
 
@@ -291,6 +302,17 @@ def gate(entries: list[dict], current: Optional[dict] = None,
                       [e["scaling_amp"][cell_key] for e in prior
                        if cell_key in e.get("scaling_amp", {})],
                       cpt_tolerance)
+    # adaptive-vs-static trajectory (--adaptive records): a cell's ratio
+    # dropping means the controller's closed loop wins less over the best
+    # hand-tuned static backoff than it used to — schedule-pure like
+    # commits_per_tick, so it shares that tolerance and self-arms once
+    # the first sweep lands in the history
+    for cell_key, cur in sorted(current.get("adaptive_vs_static",
+                                            {}).items()):
+        check(f"adaptive_vs_static[{cell_key}]", cur,
+              [e["adaptive_vs_static"][cell_key] for e in prior
+               if cell_key in e.get("adaptive_vs_static", {})],
+              cpt_tolerance)
     return {"current": current, "checks": checks, "failures": failures,
             "skipped": skipped}
 

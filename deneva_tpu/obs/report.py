@@ -161,6 +161,33 @@ def hot_keys(stats: dict, topk: int = 8) -> list:
     return [{"key": int(k), "hits": int(h)} for k, h in ranked[:topk]]
 
 
+def _ctrl_section(summary: dict) -> dict | None:
+    """The ``[ctrl]`` section: what the adaptive contention controller
+    (Config.adaptive, deneva_tpu/ctrl/) DID over the run — escalation /
+    de-escalation churn, serialization-gate stalls, width-ladder steps,
+    the end-of-run gear/occupancy, and the per-reason backoff bases it
+    converged to.  ``None`` (section omitted) when the run did not carry
+    the controller.  Sharded summaries sum the scalars over nodes, so
+    bases/gauges read as node-totals there."""
+    if "ctrl_escalate_cnt" not in summary:
+        return None
+    from deneva_tpu.cc.base import ABORT_REASONS
+    from deneva_tpu.ctrl import CTRL_SCALE
+    bases = {name: int(summary[f"ctrl_base_{name}"])
+             for name in ABORT_REASONS
+             if f"ctrl_base_{name}" in summary}
+    return {
+        "escalations": int(summary.get("ctrl_escalate_cnt", 0)),
+        "deescalations": int(summary.get("ctrl_deescalate_cnt", 0)),
+        "gate_blocks": int(summary.get("ctrl_esc_block_cnt", 0)),
+        "width_steps": int(summary.get("ctrl_width_step_cnt", 0)),
+        "esc_active": int(summary.get("ctrl_esc_active", 0)),
+        "width_idx": int(summary.get("ctrl_width_idx", 0)),
+        "occ_ewma": int(summary.get("ctrl_occ_ewma", 0)) >> CTRL_SCALE,
+        "backoff_bases": bases,
+    }
+
+
 def build_report(summary: dict, timeline: dict | None = None,
                  stats: dict | None = None, topk: int = 8,
                  xmeter: dict | None = None,
@@ -222,6 +249,9 @@ def build_report(summary: dict, timeline: dict | None = None,
         # run record's "mesh" field) — per-node-pair traffic volumes,
         # type breakdown, load planes and the imbalance block
         rep["mesh"] = mesh
+    ctrl = _ctrl_section(summary)
+    if ctrl is not None:
+        rep["ctrl"] = ctrl
     rep["reconcile_failures"] = reconcile(summary, timeline)
     findings, code = watchdog(summary, timeline,
                               precomputed_reconcile=rep["reconcile_failures"],
@@ -455,6 +485,21 @@ def render_text(rep: dict) -> str:
             lines.append("  exchange occupancy avg " + " ".join(
                 str(v) for v in pn["occ_avg"])
                 + f", peak {max(pn.get('occ_peak', [0]))}{cap}")
+    if rep.get("ctrl") is not None:
+        c = rep["ctrl"]
+        lines.append(
+            f"[ctrl] adaptive controller decisions: "
+            f"{c['escalations']} escalation(s) / "
+            f"{c['deescalations']} de-escalation(s), "
+            f"{c['gate_blocks']} gate stall(s), "
+            f"{c['width_steps']} width step(s); "
+            f"end state: {c['esc_active']} key(s) escalated, "
+            f"gear {c['width_idx']}, occupancy ewma {c['occ_ewma']}")
+        bases = {n: b for n, b in c["backoff_bases"].items() if b > 0}
+        if bases:
+            lines.append("  backoff bases (ticks): " + " ".join(
+                f"{n}={b}" for n, b in sorted(bases.items(),
+                                              key=lambda kv: -kv[1])))
     for flag, msg in rep["watchdog"]["findings"]:
         lines.append(f"[watchdog] {flag}: {msg}")
     if not rep["watchdog"]["findings"]:

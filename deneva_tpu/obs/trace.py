@@ -55,6 +55,16 @@ _FLOW = ("admit", "commit", "abort", "vabort", "user_abort", "lock_wait")
 _OCC = ("occ_free", "occ_running", "occ_waiting", "occ_backoff")
 _COMPACT = ("live_entries", "compact_ovf")
 
+#: adaptive-controller companion ring schema (deneva_tpu/ctrl/): the
+#: per-tick decision snapshot — escalated-key count, chosen width gear,
+#: occupancy/hottest-bucket EWMAs (integer part), the largest per-reason
+#: backoff base, and the CUMULATIVE escalation / gate-block counters
+#: (monotone step counters render each decision as an edge in Perfetto).
+#: Gauges, not flows: rows are meaningful per tick, so pick trace_ticks
+#: >= the run length (the wrap-accumulate caveat bites harder here).
+CTRL_COLUMNS = ("esc_active", "width_idx", "occ_ewma", "heat_max",
+                "backoff_base_max", "escalations", "esc_blocked")
+
 
 def init_trace(cfg, lat_samples: int) -> dict:
     """Stats-dict entries for the timeline; empty when tracing is off
@@ -85,6 +95,11 @@ def init_trace(cfg, lat_samples: int) -> dict:
         # SEPARATE-array discipline as the reason ring: TRACE_COLUMNS —
         # and every consumer of it — is unchanged for closed-loop runs
         out["arr_queue_trace"] = jnp.zeros(cfg.trace_ticks, jnp.int32)
+    if cfg.adaptive:
+        # controller-decision companion ring, same SEPARATE-array
+        # discipline: non-adaptive traces carry nothing extra
+        out["arr_ctrl_trace"] = jnp.zeros(
+            (cfg.trace_ticks, len(CTRL_COLUMNS)), jnp.int32)
     return out
 
 
@@ -140,6 +155,32 @@ def record_queue(stats: dict, t) -> dict:
                 stats["queue_len"], unique_indices=True)}
 
 
+def record_ctrl(stats: dict, t) -> dict:
+    """Record the adaptive controller's end-of-tick decision snapshot
+    (engine/scheduler.py calls this AFTER ctrl.update, so the row is the
+    state the NEXT tick will act under).  Same wrap-and-accumulate
+    discipline as :func:`record_tick`; no-op unless the run traces with
+    ``Config.adaptive``."""
+    if "arr_ctrl_trace" not in stats:
+        return stats
+    from deneva_tpu.cc.base import ABORT_REASONS
+    from deneva_tpu.ctrl import CTRL_SCALE
+    buf = stats["arr_ctrl_trace"]
+    row = jnp.stack([
+        stats["ctrl_esc_active"],
+        stats["ctrl_width_idx"],
+        stats["ctrl_occ_ewma"] >> CTRL_SCALE,
+        jnp.max(stats["arr_ctrl_heat"]) >> CTRL_SCALE,
+        jnp.max(jnp.stack([stats[f"ctrl_base_{n}"]
+                           for n in ABORT_REASONS])),
+        stats["ctrl_escalate_cnt"],
+        stats["ctrl_esc_block_cnt"],
+    ]).astype(jnp.int32)
+    return {**stats,
+            "arr_ctrl_trace": buf.at[t % buf.shape[0]].add(
+                row, unique_indices=True)}
+
+
 def _buffer(state_or_stats) -> np.ndarray:
     stats = getattr(state_or_stats, "stats", state_or_stats)
     assert "arr_trace" in stats, "run with Config.trace_ticks > 0"
@@ -167,6 +208,13 @@ def _mesh_buffer(state_or_stats) -> np.ndarray | None:
     return np.asarray(stats["arr_mesh_trace"])
 
 
+def _ctrl_buffer(state_or_stats) -> np.ndarray | None:
+    stats = getattr(state_or_stats, "stats", state_or_stats)
+    if "arr_ctrl_trace" not in stats:
+        return None
+    return np.asarray(stats["arr_ctrl_trace"])
+
+
 def _reason_names() -> tuple:
     from deneva_tpu.cc.base import ABORT_REASONS
     return tuple(f"abort_{name}" for name in ABORT_REASONS)
@@ -184,11 +232,13 @@ def timeline(state_or_stats, per_shard: bool = False) -> dict:
     r = _reason_buffer(state_or_stats)
     q = _queue_buffer(state_or_stats)
     m = _mesh_buffer(state_or_stats)      # stacked: (N, trace_ticks, N)
+    c = _ctrl_buffer(state_or_stats)
     if a.ndim == 3 and not per_shard:
         a = a.sum(axis=0)
         r = r.sum(axis=0) if r is not None else None
         q = q.sum(axis=0) if q is not None else None
         m = m.sum(axis=0) if m is not None else None
+        c = c.sum(axis=0) if c is not None else None
     if a.ndim == 3:
         out = {name: a[:, :, i] for i, name in enumerate(TRACE_COLUMNS)}
         if r is not None:
@@ -199,6 +249,9 @@ def timeline(state_or_stats, per_shard: bool = False) -> dict:
         if m is not None:
             out.update({f"mesh_tx_to{j}": m[:, :, j]
                         for j in range(m.shape[-1])})
+        if c is not None:
+            out.update({f"ctrl_{name}": c[:, :, i]
+                        for i, name in enumerate(CTRL_COLUMNS)})
         return out
     out = {name: a[:, i] for i, name in enumerate(TRACE_COLUMNS)}
     if r is not None:
@@ -208,6 +261,9 @@ def timeline(state_or_stats, per_shard: bool = False) -> dict:
         out["queue_depth"] = q
     if m is not None:
         out.update({f"mesh_tx_to{j}": m[:, j] for j in range(m.shape[-1])})
+    if c is not None:
+        out.update({f"ctrl_{name}": c[:, i]
+                    for i, name in enumerate(CTRL_COLUMNS)})
     return out
 
 
@@ -262,6 +318,10 @@ def to_chrome_trace(state_or_stats, path: str, n_ticks: int | None = None,
     mshards = None
     if mbuf is not None:
         mshards = mbuf[None] if mbuf.ndim == 2 else mbuf
+    cbuf = _ctrl_buffer(state_or_stats)
+    cshards = None
+    if cbuf is not None:
+        cshards = cbuf[None] if cbuf.ndim == 2 else cbuf
     rnames = _reason_names()
     N, T, _ = shards.shape
     if n_ticks is not None:
@@ -312,6 +372,16 @@ def to_chrome_trace(state_or_stats, path: str, n_ticks: int | None = None,
                                         int(mshards[node][t, j])
                                         for j in
                                         range(mshards.shape[-1])}})
+            if cshards is not None:
+                # 8th counter track (same conditional discipline): the
+                # adaptive controller's per-tick decisions — escalated
+                # keys, width gear, backoff level, cumulative
+                # escalation/gate-block edges (CTRL_COLUMNS)
+                events.append({"name": "controller decisions", "ph": "C",
+                               "ts": ts, "pid": node,
+                               "args": {c: int(cshards[node][t, i])
+                                        for i, c in
+                                        enumerate(CTRL_COLUMNS)}})
     xentries = []
     if xmeter:
         # 5th counter track, present only when an xmeter snapshot is
@@ -346,6 +416,8 @@ def to_chrome_trace(state_or_stats, path: str, n_ticks: int | None = None,
         doc["metadata"]["queue_track"] = True
     if mshards is not None:
         doc["metadata"]["mesh_track_nodes"] = int(mshards.shape[-1])
+    if cshards is not None:
+        doc["metadata"]["ctrl_track"] = list(CTRL_COLUMNS)
     if xentries:
         doc["metadata"]["xmeter_entries"] = xentries
     if flight:

@@ -50,6 +50,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from deneva_tpu.compat import shard_map
 
 from deneva_tpu import cc as cc_registry
+from deneva_tpu import ctrl
 from deneva_tpu import traffic
 from deneva_tpu import workloads as wl_registry
 from deneva_tpu.cc import base as cc_base
@@ -184,6 +185,11 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
             # per-tick reason accumulator for the trace ring (obs/trace.py)
             stats = {**stats, "arr_reason_tick":
                      jnp.zeros_like(stats["arr_reason_tick"])}
+        if cfg.adaptive:
+            # adaptive controller (deneva_tpu/ctrl/): per-NODE instance —
+            # each shard's stats dict carries its own EWMAs/ring under
+            # shard_map, fed by its home-side emission sites
+            stats = ctrl.zero_tick_planes(stats)
         # compaction-counter baseline: the trace row records this tick's
         # DELTA of the cumulative note_compaction counters (cc/base.py)
         live_base = db.get("live_entry_cnt")
@@ -391,6 +397,23 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
             finishing = finishing & txn_ok
             stats = bump(stats, "fault_stall_ticks",
                          (~self_ok).astype(jnp.int32), measuring)
+        if cfg.adaptive and plugin.esc_gate_ok and normal:
+            # hot-key serialization gate (ctrl policy b), by the fault
+            # plane's withheld-request contract above: a masked request
+            # gets no decision, so the lane stalls deterministically one
+            # tick and retries — held entries still ship.  The oldest-
+            # writer race is PER NODE (each shard runs its own
+            # controller), so concurrency on a globally hot escalated
+            # key drops from n_nodes*B writers to at most n_nodes.
+            stall = ctrl.esc_stall(cfg, stats, txn, active)
+            stats = {**stats, "ctrl_esc_block_cnt":
+                     stats["ctrl_esc_block_cnt"]
+                     + jnp.sum(stall.astype(jnp.int32))}
+            # stalls are absorbed conflicts (see the single-shard gate
+            # site): no hysteresis thrash, overload release stays armed
+            stats = ctrl.note_stall_heat(cfg, stats, txn, stall)
+            req = req & ~jnp.broadcast_to(stall[:, None],
+                                          (B, R)).reshape(-1)
         if dly:
             # finish gate: a remote-touching txn's prepare request reaches
             # its owners fin_delay ticks after it finishes executing; the
@@ -1403,12 +1426,17 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
             stats = note_conflicts(cfg, stats,
                                    wait | (abort_now & ~vabort),
                                    fail_key, wait)
-        shift = jnp.minimum(txn.restarts, 16)
-        penalty = jnp.where(
-            jnp.asarray(cfg.backoff),
-            jnp.minimum(cfg.abort_penalty_ticks * (1 << shift),
-                        cfg.abort_penalty_max_ticks),
-            cfg.abort_penalty_ticks).astype(jnp.int32)
+        if cfg.adaptive:
+            # ctrl policy (a): per-reason EWMA-tuned backoff schedule
+            # (adaptive implies abort_attribution, so code_b exists)
+            penalty = ctrl.penalty(cfg, stats, txn.restarts, code_b, t)
+        else:
+            shift = jnp.minimum(txn.restarts, 16)
+            penalty = jnp.where(
+                jnp.asarray(cfg.backoff),
+                jnp.minimum(cfg.abort_penalty_ticks * (1 << shift),
+                            cfg.abort_penalty_max_ticks),
+                cfg.abort_penalty_ticks).astype(jnp.int32)
         status = jnp.where(abort_now, STATUS_BACKOFF, status)
         cursor = jnp.where(abort_now, 0, cursor)
         backoff_base = txn.backoff_until
@@ -1436,6 +1464,13 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
             if "abort_code" in net:
                 net["abort_code"] = jnp.where(done, 0, net["abort_code"])
 
+        if cfg.adaptive:
+            # controller step (per node).  ladder_len=1: the sharded
+            # owner tick pins its virtual-entry geometry per node, so the
+            # width policy idles here — only backoff tuning and hot-key
+            # escalation adapt (the single-shard engine runs all three).
+            stats = ctrl.update(cfg, stats, txn.status, 1)
+
         # latency decomposition integrals (txn-ticks per end-of-tick state;
         # network = entry-ticks shipped to remote owners this tick)
         stats = track_state_latencies(stats, txn, measuring)
@@ -1459,6 +1494,7 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
                 live_entries=live_delta, compact_ovf=ovf_delta)
             stats = obs_trace.record_reasons(stats, t)
             stats = obs_trace.record_queue(stats, t)
+            stats = obs_trace.record_ctrl(stats, t)
             # per-dest sent counts into the mesh companion ring (the
             # per-node-pair Perfetto counter tracks; obs/mesh.py)
             stats = obs_mesh.note_trace(stats, t, mesh_per_dest)

@@ -181,6 +181,17 @@ def reference_summary(s: dict, wall_seconds: float | None = None) -> dict:
             out[k] = s[k]
     if "remote_attempt_cnt" in s and "remote_entry_cnt" in s:
         out.setdefault("remote_entry_cnt", s["remote_entry_cnt"])
+    # adaptive contention controller keys (Config.adaptive,
+    # deneva_tpu/ctrl/): per-reason backoff bases, escalation /
+    # de-escalation / width-step / gate-block counters and the
+    # occupancy EWMA pass through verbatim (integers and fixed-point
+    # gauges in CTRL_SCALE units — never time-scaled; no ``_cnt``
+    # requirement because the bases and EWMAs are gauges).  Present
+    # only when the controller is on, so the default line stays
+    # byte-identical.
+    for k in sorted(s):
+        if k.startswith("ctrl_") and k not in out:
+            out[k] = s[k]
     for k in sorted(s):
         if k.startswith("famlat") and k not in out:
             out[k] = s[k] * tick_sec if isinstance(s[k], float) else s[k]

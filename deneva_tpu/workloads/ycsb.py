@@ -68,12 +68,46 @@ class ZipfSampler:
         return np.minimum(out, self.n).astype(np.int64)
 
 
+class HotSampler:
+    """The reference's second skew generator (SKEW_METHOD == HOT,
+    ycsb_query.cpp:205-301): ACCESS_PERC of the traffic goes to the
+    DATA_PERC fraction of the table (``gen_requests_hot``'s
+    access-to-hot-data coin, with the hot set being the lowest row ids).
+    Same interface and [1, n] id range as :class:`ZipfSampler`, so the
+    de-duplication resample loop below works unchanged."""
+
+    def __init__(self, n: int, access_perc: float, data_perc: float):
+        assert n >= 1
+        self.n = n
+        self.access_perc = access_perc
+        # ceil-free floor with a 1-row minimum; data_perc == 1 degrades
+        # to uniform over the whole table (every row "hot")
+        self.hot_n = min(n, max(1, int(data_perc * n)))
+
+    def sample(self, rng: np.random.Generator, size) -> np.ndarray:
+        # reference draws u = (rand % 10M) / 10M for the access coin
+        u = rng.integers(0, 10_000_000, size=size) / 10_000_000.0
+        hot = u < self.access_perc
+        hot_ids = rng.integers(1, self.hot_n + 1, size=size)
+        if self.hot_n >= self.n:
+            return hot_ids.astype(np.int64)
+        cold_ids = rng.integers(self.hot_n + 1, self.n + 1, size=size)
+        return np.where(hot, hot_ids, cold_ids).astype(np.int64)
+
+
+def make_sampler(cfg: Config, n: int):
+    """Per-partition row-id sampler for ``Config.skew_method``."""
+    if cfg.skew_method == "hot":
+        return HotSampler(n, cfg.access_perc, cfg.data_perc)
+    return ZipfSampler(n, cfg.zipf_theta)
+
+
 def gen_query_pool(cfg: Config, seed: int | None = None) -> QueryPool:
     """Pre-generate cfg.query_pool_size YCSB transactions."""
     rng = np.random.default_rng(cfg.seed if seed is None else seed)
     Q, R, P = cfg.query_pool_size, cfg.req_per_query, cfg.part_cnt
     table_size = cfg.synth_table_size // P  # rows per partition
-    sampler = ZipfSampler(table_size - 1, cfg.zipf_theta)
+    sampler = make_sampler(cfg, table_size - 1)
 
     home_part = (np.arange(Q, dtype=np.int64) % P)
 

@@ -319,6 +319,87 @@ if [ "$scale_rc" -ne 0 ]; then
     exit "$scale_rc"
 fi
 
+echo "== adaptive smoke (controller purity + steady compiles) =="
+# the adaptive contention controller (Config.adaptive, deneva_tpu/ctrl/):
+# (1) the DEFAULT tick must carry zero controller state and repeat to an
+# identical counter dict; (2) with the controller + xmeter on, a mid-run
+# hot-set SHIFT (pool front half hot at the low ids, back half shifted
+# to mid-table) must adapt with ZERO post-warmup recompiles — every
+# decision is pre-traced; (3) the ctrl_* keys must round-trip the
+# [summary] line; (4) the certifier must hold the adaptive flag clean on
+# a two-alg cell (the full matrix runs in the certify stage below)
+env JAX_PLATFORMS=cpu python - <<'PYEOF'
+import dataclasses
+
+from deneva_tpu import stats as stats_mod
+from deneva_tpu.config import Config
+from deneva_tpu.engine.scheduler import Engine
+from deneva_tpu.workloads.ycsb import gen_query_pool
+
+# --- (1) off path: no controller state, deterministic repeat ---------
+cfg0 = Config(cc_alg="NO_WAIT", batch_size=64, synth_table_size=256,
+              req_per_query=4, zipf_theta=0.9, query_pool_size=512,
+              warmup_ticks=0)
+runs = []
+for _ in range(2):
+    eng0 = Engine(cfg0)
+    st0 = eng0.run(30)
+    assert not any(k.startswith(("ctrl_", "arr_ctrl_"))
+                   for k in st0.stats), "off-path run leaked ctrl state"
+    runs.append({k: int(v) for k, v in eng0.summary(st0).items()
+                 if isinstance(v, (int,)) or getattr(v, "ndim", 1) == 0})
+assert runs[0] == runs[1], "off-path counters not deterministic"
+
+# --- (2) adaptive through an induced hot-set shift, zero recompiles --
+cfg = Config(cc_alg="NO_WAIT", adaptive=True, abort_attribution=True,
+             heatmap_bins=32, xmeter=True, skew_method="hot",
+             access_perc=0.95, data_perc=0.01, batch_size=128,
+             synth_table_size=512, req_per_query=4,
+             query_pool_size=1024, warmup_ticks=0, admit_cap=32,
+             ctrl_esc_up=2, ctrl_esc_down=1)
+pool = gen_query_pool(cfg)
+n = cfg.synth_table_size - 1
+keys = pool.keys.copy()
+half = keys.shape[0] // 2
+# bijective remap of the back half: the hot set jumps to mid-table when
+# the pool cursor crosses (and again on every wrap) with zero retrace
+keys[half:] = ((keys[half:] + n // 2 - 1) % n) + 1
+eng = Engine(cfg, pool=dataclasses.replace(pool, keys=keys))
+state = eng.run(40)                       # warmup: compiles land here
+eng.xmeter.mark_warm()
+state = eng.run(80, state)                # cursor crosses the shift
+viol = eng.xmeter.steady_violations()
+assert viol == [], f"controller recompiled post-warmup: {viol}"
+s = eng.summary(state)
+assert int(s["ctrl_escalate_cnt"]) >= 1, "controller never escalated"
+assert int(s["ctrl_esc_block_cnt"]) >= 1, "serialization gate never fired"
+
+# --- (3) ctrl_* keys round-trip the [summary] line -------------------
+ref = stats_mod.reference_summary(s)
+parsed = stats_mod.parse_summary(stats_mod.format_summary(ref))
+ctrl_keys = [k for k in ref if k.startswith("ctrl_")]
+assert ctrl_keys, "no ctrl_ keys on the [summary] line"
+for k in ctrl_keys:
+    assert int(parsed[k]) == int(ref[k]), k
+print(f"[adaptive] off-path clean + deterministic; hot-set shift held "
+      f"steady (0 post-warmup recompiles), "
+      f"{int(s['ctrl_escalate_cnt'])} escalation(s), "
+      f"{int(s['ctrl_esc_block_cnt'])} gate stall(s); "
+      f"{len(ctrl_keys)} ctrl keys round-tripped")
+PYEOF
+adapt_rc=$?
+if [ "$adapt_rc" -eq 0 ]; then
+    timeout -k 10 300 env JAX_PLATFORMS=cpu \
+        XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+        python -m deneva_tpu.lint.certify --flags adaptive \
+        --algs NO_WAIT,OCC
+    adapt_rc=$?
+fi
+if [ "$adapt_rc" -ne 0 ]; then
+    echo "adaptive smoke FAILED (purity/steady-compile/certify rc=$adapt_rc)"
+    exit "$adapt_rc"
+fi
+
 echo "== bench regression gate =="
 # gate the latest trajectory point (committed BENCH_r*.json snapshots +
 # any results/bench_history.jsonl) against the median of its priors;

@@ -1,0 +1,63 @@
+"""HOT skew mode (Config.skew_method, ycsb_query.cpp:205-301).
+
+The reference's second skew generator: ACCESS_PERC of the traffic goes
+to the DATA_PERC fraction of the table (the lowest row ids).  These
+tests pin the sampler's statistics and the gen_query_pool dispatch.
+"""
+
+import numpy as np
+import pytest
+
+from deneva_tpu.config import Config
+from deneva_tpu.workloads.ycsb import (HotSampler, ZipfSampler,
+                                       gen_query_pool, make_sampler)
+
+
+def test_hot_sampler_distribution():
+    s = HotSampler(1000, access_perc=0.8, data_perc=0.1)
+    assert s.hot_n == 100
+    ids = s.sample(np.random.default_rng(7), 100_000)
+    assert ids.min() >= 1 and ids.max() <= 1000
+    frac = float((ids <= s.hot_n).mean())
+    assert abs(frac - 0.8) < 0.05, frac
+    # hot draws are uniform over the hot set (each hot row ~ frac/hot_n)
+    hot_counts = np.bincount(ids[ids <= s.hot_n], minlength=s.hot_n + 1)[1:]
+    assert hot_counts.min() > 0
+    assert hot_counts.max() < 4 * hot_counts.mean()
+
+
+def test_hot_sampler_degenerate_all_hot():
+    s = HotSampler(50, access_perc=0.75, data_perc=1.0)
+    assert s.hot_n == 50
+    ids = s.sample(np.random.default_rng(0), 10_000)
+    assert ids.min() >= 1 and ids.max() <= 50
+
+
+def test_hot_sampler_min_one_row():
+    s = HotSampler(10, access_perc=0.9, data_perc=0.001)
+    assert s.hot_n == 1
+    ids = s.sample(np.random.default_rng(1), 10_000)
+    assert abs(float((ids == 1).mean()) - 0.9) < 0.05
+
+
+def test_make_sampler_dispatch():
+    hot = Config(cc_alg="NO_WAIT", skew_method="hot")
+    assert isinstance(make_sampler(hot, 100), HotSampler)
+    zipf = Config(cc_alg="NO_WAIT")
+    assert isinstance(make_sampler(zipf, 100), ZipfSampler)
+
+
+def test_pool_hot_fraction():
+    cfg = Config(cc_alg="NO_WAIT", skew_method="hot", access_perc=0.75,
+                 data_perc=0.1, synth_table_size=4096,
+                 query_pool_size=2048, req_per_query=4, warmup_ticks=0)
+    pool = gen_query_pool(cfg)
+    # part_cnt 1: primary key == row id, hot set == ids [1, hot_n]
+    hot_n = max(1, int(cfg.data_perc * (cfg.synth_table_size - 1)))
+    frac = float((pool.keys <= hot_n).mean())
+    assert abs(frac - cfg.access_perc) < 0.05, frac
+
+
+def test_skew_method_validated():
+    with pytest.raises(AssertionError):
+        Config(cc_alg="NO_WAIT", skew_method="pareto")

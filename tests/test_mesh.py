@@ -143,6 +143,8 @@ def test_split_exchange_reconciles_and_matches_baseline():
         assert s[k] == s_base[k], (k, s[k], s_base[k])
 
 
+@pytest.mark.slow  # two full MAAT mesh cells; tier-1 keeps the
+# test_scale_out.py rcache plane/gating cell and the runtime reconcile
 def test_remote_cache_counters_and_attempts_identity():
     """Config.remote_cache (remote-grant stickiness): the MAAT cell's
     cache counters join the summary, every suppressed re-ship is an
