@@ -39,6 +39,18 @@ KNEE (the highest rate still served with served/offered >= 0.95) and
 recording p50/p99 latency, queue depth and the OVERLOAD watchdog bit per
 point into ``offered_load_sweep.json``.  EXPERIMENTS.md has the recipe.
 
+With ``--serve`` the script runs the LONG-RUNNING serve loop
+(deneva_tpu/obs/telemetry.py, Config.slo): a flash-crowd rate-step
+schedule plus a mid-run hot-set shift on the open-system cell, with the
+host-side exporter streaming OpenMetrics + JSONL from the exact
+mergeable latency histograms every ``slo_export_interval`` ticks (pure
+np.asarray reads — never entering the jit path), multi-window
+error-budget burn-rate alerting (obs/slo.py) printing the live SLO
+table, and the xmeter sentinel proving ZERO steady-state recompiles
+across the whole schedule.  Writes ``serve_slo.json``; the SLO watchdog
+bit (128, obs/report.py) and the recompile/reconcile bits ride the
+exit code.  EXPERIMENTS.md has the flash-crowd recipe.
+
 With ``--faults`` the script runs the fault-plane smoke (Config.faults,
 deneva_tpu/faults/): three scenarios on a small 2-node sharded CALVIN
 cell — a mid-run node KILL recovered by deterministic replay from the
@@ -275,7 +287,7 @@ def run_offered_load(args, out_dir: str = "results",
         points = []
         for rate in rates:
             cfg = Config(cc_alg=alg, arrival="poisson", arrival_rate=rate,
-                         **OBS_KW)
+                         slo=True, **OBS_KW)
             eng = Engine(cfg)
             state = eng.run(args.ticks)
             s = eng.summary(state)
@@ -293,8 +305,17 @@ def run_offered_load(args, out_dir: str = "results",
                 "served_frac": round(frac, 4),
                 "commits_per_tick": round(s["txn_cnt"] / ticks, 2),
                 "p50": ccl["ccl50"], "p99": ccl["ccl99"],
-                "famlat_p50": s.get("famlat0_p50", 0.0),
-                "famlat_p99": s.get("famlat0_p99", 0.0),
+                # long-latency quantiles ROUTED THROUGH the exact SLO
+                # histograms (obs/histo.py, Config.slo above): the famlat
+                # survivor rings keep only the last fam_lat_samples
+                # commits per family and bias the tail once arrivals
+                # outrun them (tests/test_telemetry.py demonstrates the
+                # divergence); the ring values stay as fallback for
+                # slo-less replays of old sweeps
+                "famlat_p50": s.get("slo_fam0_p50",
+                                    s.get("famlat0_p50", 0.0)),
+                "famlat_p99": s.get("slo_fam0_p99",
+                                    s.get("famlat0_p99", 0.0)),
                 "queue_len": s["queue_len"],
                 "queue_peak": s["queue_peak"],
                 "watchdog": wd,
@@ -340,13 +361,165 @@ def run_offered_load(args, out_dir: str = "results",
     print(f"[offered-load] sweep written: {path}")
     if history:
         _append_history(doc, Config(cc_alg=alg_list[0], arrival="poisson",
-                                    arrival_rate=rates[0], **OBS_KW),
+                                    arrival_rate=rates[0], slo=True,
+                                    **OBS_KW),
                         out_dir)
     return code
 
 
 _ALGS = ("NO_WAIT", "WAIT_DIE", "TIMESTAMP", "MVCC", "OCC", "MAAT",
          "CALVIN")
+
+
+def run_serve(args, out_dir: str = "results", history: bool = True) -> int:
+    """--serve: the long-running serve loop + streaming telemetry plane.
+
+    Drives the open-system traffic engine (deneva_tpu/traffic/) through
+    a flash-crowd rate-step schedule (low -> burst at 1/4 -> back to low
+    at 1/2 of the run) AND a mid-run hot-set shift (the check.sh
+    adaptive-smoke idiom: the query pool's back half is bijectively
+    remapped to mid-table, so the hot set jumps when the pool cursor
+    crosses — pure data flow, nothing retraces), while the host-side
+    exporter (obs/telemetry.py) polls the carried stats every
+    ``Config.slo_export_interval`` ticks WITHOUT entering the jit path:
+
+    - OpenMetrics text exposition atomically rewritten per poll
+      (``metrics.om``) + append-only JSONL stream (``telemetry.jsonl``),
+      quantiles from the EXACT mergeable histograms (obs/histo.py);
+    - multi-window error-budget burn-rate alerting (obs/slo.py): the
+      burst burns the budget and FIRES, the drain clears it — the
+      fire -> drain -> clear timeline prints as the live SLO table;
+    - the whole schedule runs under the obs/xmeter.py recompile
+      sentinel: ZERO steady-state recompiles after the warmup interval.
+
+    Writes ``<out-dir>/serve_slo.json`` and appends a ``serve_slo``
+    record whose per-family ``slo_p99`` cells feed the self-arming
+    obs/regress.py ceiling gate.  Exit bitmask: 1 = post-warmup
+    recompile, 2 = histogram reconciliation failure, plus the watchdog
+    bitmask (SLO bit 128 = alert still firing at run end)."""
+    import dataclasses
+    from deneva_tpu.obs import report as obs_report
+    from deneva_tpu.obs import telemetry as obs_telemetry
+    from deneva_tpu.workloads.ycsb import gen_query_pool
+
+    total = args.serve_ticks
+    low, high = args.serve_rate, args.serve_burst
+    # burst for total/8 ticks starting at 1/4: the crowd's backlog must
+    # be DRAINABLE in the remaining window (capacity - low per tick), so
+    # the alert can clear before run end — a burst that outruns the
+    # drain leaves the SLO watchdog bit (128) firing, by design
+    t_up, t_down = total // 4, (total * 3) // 8
+    schedule = ((0, low), (t_up, high), (t_down, low))
+    kw = dict(OBS_KW)
+    # serve cell: zipf 0.5 (not the OBS 0.8) so the steady-state tail
+    # sits crisply UNDER the 16-tick p99 ceiling (measured window bad
+    # frac 0.0 at the baseline rate vs 30%+ inside the crowd) — the
+    # zipf 0.8 cell's backoff stragglers breach any tight ceiling even
+    # at steady state and the alert flaps instead of clearing; the
+    # smaller pool makes the cursor actually CROSS the remapped back
+    # half (the hot-set shift) several times inside the run
+    kw.update(zipf_theta=0.5, query_pool_size=1 << 10)
+    cfg = Config(cc_alg=args.cc_alg, slo=True, xmeter=True,
+                 slo_p99_ceiling=16, abort_attribution=True,
+                 arrival="step", arrival_schedule=schedule, **kw)
+    # hot-set shift: front half of the pool hammers the low-id hot rows,
+    # back half the same rows remapped to mid-table (bijective, zero
+    # retrace when the cursor crosses)
+    pool = gen_query_pool(cfg)
+    n = cfg.synth_table_size - 1
+    keys = pool.keys.copy()
+    half = keys.shape[0] // 2
+    keys[half:] = ((keys[half:] + n // 2 - 1) % n) + 1
+    eng = Engine(cfg, pool=dataclasses.replace(pool, keys=keys))
+
+    os.makedirs(out_dir, exist_ok=True)
+    exporter = obs_telemetry.TelemetryExporter(cfg, out_dir)
+    tracker = exporter.tracker
+    interval = max(int(cfg.slo_export_interval), 1)
+
+    t0 = time.perf_counter()
+    state = eng.run(interval)          # warmup interval: compiles land here
+    eng.xmeter.mark_warm()
+    tick = interval
+    records = [exporter.poll(state, tick)]
+    while tick < total:
+        state = eng.run(interval, state)
+        tick += interval
+        records.append(exporter.poll(state, tick))
+    wall = time.perf_counter() - t0
+
+    summary = eng.summary(state, wall)
+    summary.update(tracker.summary_fields())
+    print(eng.summary_line(state, wall))
+
+    code = 0
+    viol = eng.xmeter.steady_violations()
+    if viol:
+        for v in viol:
+            print(f"[serve] RECOMPILE {v['entry']}: {v['signature']}")
+        code |= 1
+    else:
+        cnt, ms = eng.xmeter.compile_totals()
+        print(f"[serve] zero steady-state recompiles across the rate "
+              f"step + hot-set shift ({cnt} warmup compiles, "
+              f"{ms:.0f} ms, {len(records)} polls)")
+
+    hist_total = int(summary["hist_total_cnt"])
+    commits = int(summary["txn_cnt"])
+    ok = hist_total == commits
+    print(f"[reconcile] hist_total_cnt={hist_total} txn_cnt={commits} "
+          f"{'OK' if ok else 'MISMATCH'}")
+    if not ok:
+        code |= 2
+
+    # the live SLO table: one row per exporter poll (the JSONL stream)
+    print("[serve]  tick  rate    p99  burn_fast  burn_slow  served  "
+          "alert")
+    for rec in records:
+        rate = [p for p in schedule if p[0] <= rec["tick"]][-1][1]
+        flag = rec.get("event", "").upper() \
+            or ("firing" if rec["alert_active"] else "")
+        print(f"  {rec['tick']:>6} {rate:>5g} {rec['fam']['0']['p99']:>6g}"
+              f" {rec['burn_fast']:>10.2f} {rec['burn_slow']:>10.2f}"
+              f" {rec['served_frac']:>7.3f}  {flag}")
+
+    rep = obs_report.build_report(summary)
+    print(obs_report.render_text(rep))
+    code |= rep["watchdog"]["exit_code"]
+
+    slo_p99 = {f"fam{fr['family']}": fr["p99"]
+               for fr in rep.get("slo", {}).get("families", [])}
+    doc = {
+        "metric": "serve_slo",
+        "value": float(summary.get("slo_fam0_p99", 0.0)),
+        "unit": "p99_ticks",
+        "ticks": total,
+        "interval": interval,
+        "schedule": [list(p) for p in schedule],
+        "slo_p99": slo_p99,
+        "alerts": [list(e) for e in tracker.events],
+        "burn_fast": round(float(summary["burn_fast"]), 4),
+        "burn_slow": round(float(summary["burn_slow"]), 4),
+        "breach_ticks": int(summary["slo_breach_ticks"]),
+        "steady_recompiles": len(viol),
+        "watchdog": rep["watchdog"]["exit_code"],
+        "artifacts": {"openmetrics": exporter.om_path,
+                      "jsonl": exporter.jsonl_path},
+        "note": "flash-crowd serve loop: rate step low->burst->low + "
+                "mid-run hot-set shift under the xmeter sentinel; p99 "
+                "from the exact histogram plane; alerts = the "
+                "(tick, fire/clear) burn-rate timeline; exit bitmask "
+                "1=recompile 2=hist reconcile | watchdog (SLO=128)",
+    }
+    path = os.path.join(out_dir, "serve_slo.json")
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(json.dumps({k: v for k, v in doc.items()
+                      if k not in ("schedule", "artifacts")}))
+    print(f"[serve] record written: {path}")
+    if history:
+        _append_history(doc, cfg, out_dir)
+    return code
 
 
 # the small sharded cell every scaling-grid point runs (the OBS_KW analog
@@ -938,8 +1111,11 @@ def _append_history(doc: dict, cfg: Config, out_dir: str = "results") -> str:
     # --adaptive records ride the same way: the per-cell ratio dict keys
     # a distinct "adaptive_contention" trajectory with a self-arming
     # floor in obs/regress.py
+    # --serve records ride the same way: the per-family p99 dict keys a
+    # distinct "serve_slo" trajectory with a self-arming CEILING (lower
+    # is better) in obs/regress.py
     for k in ("offered_load", "knee", "nodes", "batch_shapes",
-              "scaling_grid", "adaptive_vs_static"):
+              "scaling_grid", "adaptive_vs_static", "slo_p99"):
         if k in doc:
             rec[k] = doc[k]
     os.makedirs(out_dir, exist_ok=True)
@@ -1108,6 +1284,23 @@ def _cli():
     p.add_argument("--algs", default="all",
                    help="comma-separated CC algorithms for "
                         "--offered-load (default: all seven)")
+    p.add_argument("--serve", action="store_true",
+                   help="long-running serve loop: flash-crowd rate step "
+                        "+ mid-run hot-set shift on the open-system "
+                        "cell with Config.slo on, the obs/telemetry.py "
+                        "exporter streaming OpenMetrics + JSONL every "
+                        "slo_export_interval ticks and the xmeter "
+                        "sentinel proving zero steady-state recompiles; "
+                        "writes serve_slo.json (exit bitmask 1=recompile "
+                        "2=hist reconcile | watchdog)")
+    p.add_argument("--serve-ticks", type=int, default=360,
+                   help="total serve-loop ticks (burst at 1/4, drain "
+                        "at 1/2)")
+    p.add_argument("--serve-rate", type=float, default=4.0,
+                   help="baseline arrival rate for --serve "
+                        "(arrivals/tick)")
+    p.add_argument("--serve-burst", type=float, default=48.0,
+                   help="flash-crowd burst arrival rate for --serve")
     p.add_argument("--scaling-grid", action="store_true",
                    help="cluster scaling surface: virtual-node grid x "
                         "two fit_batch-sized per-node batch shapes on "
@@ -1186,6 +1379,9 @@ if __name__ == "__main__":
     if _args.offered_load:
         raise SystemExit(run_offered_load(_args, out_dir=_args.out_dir,
                                           history=not _args.no_history))
+    if _args.serve:
+        raise SystemExit(run_serve(_args, out_dir=_args.out_dir,
+                                   history=not _args.no_history))
     if _args.adaptive:
         raise SystemExit(run_adaptive(_args, out_dir=_args.out_dir,
                                       history=not _args.no_history))

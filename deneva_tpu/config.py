@@ -589,6 +589,42 @@ class Config:
     #: read the full picture from ``engine.xmeter.snapshot()``.
     xmeter: bool = _optin(False, {"xmeter": True})
 
+    #: live SLO & telemetry plane (deneva_tpu/obs/histo.py, slo.py,
+    #: telemetry.py): jit-pure, EXACTLY-mergeable log-bucket latency
+    #: histograms carried in the donated stats carry — ``arr_hist_fam``
+    #: (commit latency per txn family; total count == txn_cnt exactly)
+    #: and ``arr_hist_phase`` (per-tick slot occupancy per lat_* phase;
+    #: each row sums to measured_ticks) — feeding ``hist_*`` /
+    #: ``slo_fam{f}_p50/p95/p99`` [summary] quantiles that stay exact
+    #: under load where the famlat survivor rings bias the tail, the
+    #: multi-window error-budget burn alerting of obs/slo.py, the
+    #: streaming OpenMetrics/JSONL exporter of obs/telemetry.py and the
+    #: ``bench.py --serve`` loop.  Off by default: zero extra device
+    #: arrays and a byte-identical [summary] line (certified).
+    slo: bool = _optin(False, {"slo": True})
+    #: histogram bins (multiple of obs/histo.py HIST_SUB=8; buckets
+    #: 0..15 are exact single-tick cells, later octaves keep 3 mantissa
+    #: bits = <= 12.5% relative width; 96 bins reach ~15k ticks)
+    slo_hist_bins: int = 96
+    #: latency objective: commits whose bucket lies entirely above this
+    #: many ticks count against the error budget
+    slo_p99_ceiling: int = 64
+    #: SLO target fraction (error budget = 1 - target)
+    slo_target: float = 0.99
+    #: burn-rate windows (ticks) + threshold: the alert fires when BOTH
+    #: windows burn budget faster than the threshold multiple, clears
+    #: when the fast window drops back under (obs/slo.py)
+    slo_burn_fast: int = 5
+    slo_burn_slow: int = 50
+    slo_burn_threshold: float = 2.0
+    #: open-system service objectives per fast window: admitted/arrived
+    #: floor and aborts/(aborts+commits) cap (dashboard counters, not
+    #: alert gates)
+    slo_served_floor: float = 0.95
+    slo_abort_cap: float = 0.5
+    #: serve-loop poll cadence (ticks between exporter snapshots)
+    slo_export_interval: int = 10
+
     # --- run protocol (reference config.h:349-350: 60s warmup + 60s run) ---
     seed: int = 12345
     query_pool_size: int = 1 << 16    # pre-generated queries (client_query.cpp:30)
@@ -669,6 +705,21 @@ class Config:
             assert self.ctrl_esc_overload >= 2, \
                 "overload bound must sit above the escalation threshold"
             assert self.ctrl_sub_ticks >= 2
+        if self.slo:
+            # histogram geometry: whole octaves only, and at least the
+            # exact range (buckets 0..15) plus one log octave
+            assert self.slo_hist_bins % 8 == 0 and \
+                self.slo_hist_bins >= 16, \
+                "slo_hist_bins must be a multiple of 8 and >= 16"
+            assert self.slo_p99_ceiling >= 1
+            assert 0.0 < self.slo_target < 1.0, \
+                "slo_target is a fraction; the error budget is 1-target"
+            assert 0 < self.slo_burn_fast < self.slo_burn_slow, \
+                "burn windows: 0 < fast < slow (multi-window alerting)"
+            assert self.slo_burn_threshold > 0
+            assert 0.0 < self.slo_served_floor <= 1.0
+            assert 0.0 < self.slo_abort_cap < 1.0
+            assert self.slo_export_interval > 0
         if self.faults:
             assert self.node_cnt > 1, \
                 "faults need a multi-node topology (sharded engine)"

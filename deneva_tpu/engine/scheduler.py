@@ -36,6 +36,7 @@ from deneva_tpu.cc import base as cc_base
 from deneva_tpu.config import Config
 from deneva_tpu import traffic
 from deneva_tpu.obs import flight as obs_flight
+from deneva_tpu.obs import histo as obs_histo
 from deneva_tpu.obs import trace as obs_trace
 from deneva_tpu.obs.prog import ProgressEmitter
 from deneva_tpu.obs.profiler import PhaseProfiler
@@ -154,6 +155,14 @@ def _zeros_stats(cfg: Config | None = None,
         # Off ⇒ zero extra device arrays (the off-path identity cell in
         # scripts/check.sh holds the [summary] bytes to it).
         s.update(ctrl.init_ctrl(cfg))
+    if cfg is not None and cfg.slo:
+        # live SLO plane (obs/histo.py): exactly-mergeable log-bucket
+        # latency histograms — per-family commit latency (total count ==
+        # txn_cnt) and per-tick phase occupancy (each row sums to
+        # measured_ticks) — plus the per-tick SLO gauge ring when the
+        # timeline is on.  Accumulated at the shared commit/harvest
+        # helpers, so both engines feed the same planes.
+        s.update(obs_histo.init_histo(cfg, n_families))
     if cfg is not None:
         # per-tick timeline ring (obs/trace.py); {} when trace_ticks == 0
         s.update(obs_trace.init_trace(cfg, LAT_SAMPLES))
@@ -450,13 +459,18 @@ def append_log_ring(stats: dict, cfg: Config, wflat, keys_flat,
 def track_state_latencies(stats: dict, txn: TxnState, measuring) -> dict:
     """End-of-tick latency decomposition integrals (the lat_* families of
     stats.cpp:992-999).  Shared by both engines."""
+    counts = []
     for key, st_v in (("lat_process_time", STATUS_RUNNING),
                       ("lat_cc_block_time", STATUS_WAITING),
                       ("lat_abort_time", STATUS_BACKOFF)):
-        stats = bump(stats, key,
-                     jnp.sum((txn.status == st_v).astype(jnp.int32)),
-                     measuring)
-    return stats
+        n = jnp.sum((txn.status == st_v).astype(jnp.int32))
+        counts.append(n)
+        stats = bump(stats, key, n, measuring)
+    # SLO plane: bucket this tick's per-phase occupancies into
+    # arr_hist_phase (obs/histo.py; no-op when Config.slo is off) — the
+    # histogram view of the same lat_* vocabulary, one increment per row
+    # per measured tick
+    return obs_histo.record_phase_counts(stats, counts, measuring)
 
 
 def recon_defer(stats: dict, workload, txn_type, free, status,
@@ -943,6 +957,7 @@ def make_tick(cfg: Config, plugin, pool_dev: dict, workload=None):
             stats = obs_trace.record_reasons(stats, t)
             stats = obs_trace.record_queue(stats, t)
             stats = obs_trace.record_ctrl(stats, t)
+            stats = obs_trace.record_slo(cfg, stats, t)
 
         # ts wraparound guard: only relative order matters, and every live
         # txn's ts lies within [ts_counter - horizon, ts_counter], so rebase
@@ -1162,6 +1177,12 @@ class Engine:
             # view; arrival runs only — deneva_tpu/traffic/)
             out.update(traffic.family_percentiles(
                 state.stats["arr_fam_lat"], state.stats["arr_fam_cursor"]))
+        if "arr_hist_fam" in state.stats:
+            # SLO histogram plane (obs/histo.py): hist_* reconciliation
+            # counts + exact slo_fam{f}_p50/p95/p99 quantiles — unlike
+            # famlat these never bias under load (no survivor ring)
+            out.update(obs_histo.summary_keys(
+                state.stats["arr_hist_fam"], state.stats["arr_hist_phase"]))
         if wall_seconds is not None:
             out["tput"] = s["txn_cnt"] / wall_seconds
         if self.xmeter is not None:

@@ -70,6 +70,12 @@ def record_events(rec: dict, pid_base: int = 0, tick_us: float = 1.0,
     # adaptive-controller decision series (obs/trace.py CTRL_COLUMNS,
     # present only for Config.adaptive runs with a trace ring)
     ctrl_names = sorted(k for k in timeline if k.startswith("ctrl_"))
+    # SLO plane gauges (obs/trace.py record_slo: slo_f{f}_p99 /
+    # slo_f{f}_burn, Config.slo runs with a trace ring); numeric family
+    # sort so f10 doesn't land between f1 and f2
+    slo_names = sorted((k for k in timeline if k.startswith("slo_f")),
+                       key=lambda k: (int(k[len("slo_f"):].split("_")[0]),
+                                      k))
     for node in range(n_nodes):
         pid = pid_base + node
         pname = label or "engine"
@@ -92,7 +98,8 @@ def record_events(rec: dict, pid_base: int = 0, tick_us: float = 1.0,
         for t_name, cols in (("abort reasons", reason_names),
                              ("admission queue", ("queue_depth",)),
                              ("mesh traffic", mesh_names),
-                             ("controller decisions", ctrl_names)):
+                             ("controller decisions", ctrl_names),
+                             ("slo burn rate", slo_names)):
             series = {c: _series(timeline, c, node, n_nodes)
                       for c in cols}
             series = {c: s for c, s in series.items() if s is not None}

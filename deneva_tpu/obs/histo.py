@@ -1,0 +1,227 @@
+"""Mergeable on-device latency histograms: the exact-tail device plane.
+
+The PR 6 ``famlat`` survivor rings keep the LAST ``fam_lat_samples``
+commit latencies per family, so once arrivals outrun the ring the p99
+is computed over a biased suffix — exactly when the tail matters most
+(the flash crowd the SLO plane exists to watch).  This module replaces
+sampling with counting: HDR-style log-bucket histograms carried in the
+donated stats carry, accumulated jit-pure at the existing commit /
+harvest sites and merged EXACTLY (elementwise int32 add — associative,
+commutative, lossless), so the cluster histogram is bit-equal to the
+numpy sum of the per-shard planes and quantiles are exact to the bucket
+resolution no matter the arrival rate.
+
+Bucketing (:func:`bucket_of`): value ``v`` keeps :data:`HIST_MANTISSA`
+mantissa bits — ``shift = max(msb(v) - 3, 0)``, ``bucket = shift * 8 +
+(v >> shift)`` — so buckets 0..15 are EXACT single-tick cells and every
+later bucket has <= 12.5% relative width (``HIST_SUB = 8`` sub-buckets
+per octave).  The default 96 bins cover latencies to ~15k ticks with
+the last bucket open-ended (clip).
+
+Two planes ride the carry when ``Config.slo`` is on (``arr_``-prefixed
+like every non-summary array, so both engines' scalar summaries skip
+them):
+
+- ``arr_hist_fam``    ``(F, BINS)``  commit latency (first start ->
+  commit, the famlat LONG latency) per txn family; total count ==
+  ``txn_cnt`` EXACTLY (same ``commit & measuring`` take mask).
+- ``arr_hist_phase``  ``(3, BINS)``  per-tick slot-occupancy histograms
+  for the ``lat_*`` phase vocabulary (:data:`PHASES`: process /
+  cc_block / abort): each measured tick buckets the number of slots in
+  that state, so every row sums to ``measured_ticks`` EXACTLY.
+
+Off path (``Config.slo`` false, the default) this module contributes
+zero carried arrays and zero summary keys — the certifier holds the
+flag byte-identical like every other ``_optin`` observatory.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: sub-buckets per octave (2**HIST_MANTISSA): <= 1/HIST_SUB relative
+#: bucket width past the exact range
+HIST_SUB = 8
+HIST_MANTISSA = 3
+
+#: arr_hist_phase rows, mirroring the lat_* harvest vocabulary of
+#: engine/scheduler.py track_state_latencies
+PHASES = ("process", "cc_block", "abort")
+
+#: slo_fam{f}_p{P} summary quantiles (matches traffic/arrival.py
+#: FAM_PCTS so the histogram view is drop-in comparable to famlat)
+SLO_PCTS = (50, 95, 99)
+
+
+# ---------------------------------------------------------------------------
+# bucket geometry (host + device views of the SAME mapping)
+# ---------------------------------------------------------------------------
+
+def bucket_of(v, bins: int):
+    """Jit-pure log-bucket index for int value(s) ``v`` (clipped to
+    ``[0, bins)``; negatives bucket as 0, the last bucket is
+    open-ended)."""
+    v = jnp.maximum(jnp.asarray(v, jnp.int32), 0)
+    msb = 31 - jax.lax.clz(jnp.maximum(v, 1))
+    shift = jnp.maximum(msb - HIST_MANTISSA, 0)
+    b = shift * HIST_SUB + jax.lax.shift_right_logical(v, shift)
+    return jnp.minimum(b, bins - 1)
+
+
+def bucket_lows(bins: int) -> np.ndarray:
+    """Inclusive lower bound of every bucket (int64 host array); the
+    exact inverse of :func:`bucket_of` on bucket boundaries."""
+    b = np.arange(bins, dtype=np.int64)
+    s = np.maximum(b // HIST_SUB - 1, 0)
+    return (b - s * HIST_SUB) << s
+
+
+def bucket_widths(bins: int) -> np.ndarray:
+    """Value count covered by each bucket (the last one nominally)."""
+    b = np.arange(bins, dtype=np.int64)
+    s = np.maximum(b // HIST_SUB - 1, 0)
+    return np.int64(1) << s
+
+
+def bucket_value(b: int, bins: int) -> float:
+    """Representative (midpoint) value of bucket ``b`` — exact for the
+    single-width buckets 0..15."""
+    return float(bucket_lows(bins)[b] + (bucket_widths(bins)[b] - 1) / 2)
+
+
+def quantile(counts, q: float) -> float:
+    """Exact-to-bucket-resolution quantile of one histogram row: the
+    representative value of the bucket holding the ``ceil(q * n)``-th
+    sample (0.0 on an empty row)."""
+    counts = np.asarray(counts, np.int64)
+    n = int(counts.sum())
+    if n == 0:
+        return 0.0
+    rank = max(int(np.ceil(q * n)), 1)
+    b = int(np.searchsorted(np.cumsum(counts), rank))
+    return bucket_value(b, counts.shape[0])
+
+
+# ---------------------------------------------------------------------------
+# carried planes: init + jit-pure accumulation
+# ---------------------------------------------------------------------------
+
+def init_histo(cfg, n_families: int = 1) -> dict:
+    """Stats-dict entries for the SLO histogram plane; empty when
+    ``Config.slo`` is off (the disabled path carries nothing)."""
+    if not cfg.slo:
+        return {}
+    bins = cfg.slo_hist_bins
+    out = {
+        "arr_hist_fam": jnp.zeros((n_families, bins), jnp.int32),
+        "arr_hist_phase": jnp.zeros((len(PHASES), bins), jnp.int32),
+    }
+    if cfg.trace_ticks > 0:
+        # per-tick SLO gauge ring -> the "slo burn rate" Perfetto track
+        # (obs/trace.py record_slo): [p99_f0..p99_fF, burn_f0..burn_fF]
+        out["arr_slo_trace"] = jnp.zeros((cfg.trace_ticks, 2 * n_families),
+                                         jnp.int32)
+    return out
+
+
+def record_commit(stats: dict, commit, txn_type, lat, measuring) -> dict:
+    """Bucket committing txns' LONG latencies into the per-family
+    histogram.  Dead lanes scatter to the out-of-bounds family row F
+    and drop; the add is commutative, so duplicate (fam, bucket) cells
+    race-free accumulate (LINT.md scatter discipline).  No-op when the
+    plane is off."""
+    if "arr_hist_fam" not in stats:
+        return stats
+    hist = stats["arr_hist_fam"]
+    F, bins = hist.shape
+    take = commit & measuring
+    fam = jnp.where(take, jnp.clip(txn_type, 0, F - 1), F)
+    b = bucket_of(lat, bins)
+    return {**stats,
+            "arr_hist_fam": hist.at[fam, b].add(1, mode="drop")}
+
+
+def record_phase_counts(stats: dict, counts, measuring) -> dict:
+    """Bucket this tick's per-phase slot occupancies (``counts`` in
+    :data:`PHASES` order, int32 scalars) — one increment per row per
+    measured tick, so every row sums to ``measured_ticks`` exactly.
+    Unmeasured ticks scatter to the out-of-bounds row and drop."""
+    if "arr_hist_phase" not in stats:
+        return stats
+    hist = stats["arr_hist_phase"]
+    P, bins = hist.shape
+    rows = jnp.where(measuring, jnp.arange(P, dtype=jnp.int32), P)
+    b = bucket_of(jnp.stack(counts), bins)
+    return {**stats,
+            "arr_hist_phase": hist.at[rows, b].add(1, mode="drop")}
+
+
+# ---------------------------------------------------------------------------
+# device-side quantile / burn estimates (the trace-ring gauges)
+# ---------------------------------------------------------------------------
+
+def device_quantile(hist_row, lows, q: float):
+    """Jit-pure bucket-low quantile of one histogram row (int32 ticks;
+    0 on an empty row).  ``lows`` is the baked :func:`bucket_lows`
+    constant."""
+    total = jnp.sum(hist_row)
+    rank = jnp.maximum(jnp.ceil(q * total).astype(jnp.int32), 1)
+    idx = jnp.argmax(jnp.cumsum(hist_row) >= rank)
+    return jnp.where(total > 0, lows[idx], 0).astype(jnp.int32)
+
+
+def device_burn_milli(hist_row, over_mask, budget: float):
+    """Jit-pure cumulative burn rate x1000 (int32 fixed point): the
+    fraction of samples whose bucket lies entirely ABOVE the latency
+    ceiling, over the error budget ``1 - slo_target``.  ``over_mask``
+    is the baked ``bucket_lows > ceiling`` int32 constant."""
+    total = jnp.sum(hist_row)
+    over = jnp.sum(hist_row * over_mask)
+    burn = over.astype(jnp.float32) / jnp.maximum(total, 1) / budget
+    return jnp.where(total > 0, (burn * 1000.0), 0.0).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# host-side summary + cluster merge
+# ---------------------------------------------------------------------------
+
+def _collapse(plane) -> np.ndarray:
+    """Host view of a carried plane: node-stacked ``(N, R, BINS)``
+    arrays np-sum over the node axis (exact merge — int add)."""
+    plane = np.asarray(plane)
+    return plane.sum(axis=0, dtype=np.int64) if plane.ndim == 3 else plane
+
+
+def summary_keys(fam_plane, phase_plane) -> dict:
+    """``hist_*`` / ``slo_fam{f}_*`` [summary] keys from the carried
+    planes (single-shard ``(R, BINS)`` or node-stacked
+    ``(N, R, BINS)``)."""
+    fam = _collapse(fam_plane)
+    phase = _collapse(phase_plane)
+    out = {"hist_total_cnt": int(fam.sum()),
+           "hist_phase_cnt": int(phase.sum())}
+    for f in range(fam.shape[0]):
+        out[f"slo_fam{f}_n"] = int(fam[f].sum())
+        for p in SLO_PCTS:
+            out[f"slo_fam{f}_p{p}"] = quantile(fam[f], p / 100.0)
+    return out
+
+
+def cluster_plane(jax_mesh, plane_stacked) -> np.ndarray:
+    """Device-side psum of the node-stacked histogram planes over the
+    node axis in one jitted shard_map — bit-exact equal to the host
+    ``plane_stacked.sum(axis=0)`` (int add is exact; the identity the
+    tests assert).  Same pattern as obs/mesh.py cluster_matrix."""
+    from jax.sharding import PartitionSpec as P
+    from deneva_tpu.compat import shard_map
+    axis = jax_mesh.axis_names[0]
+    spec = P(axis)
+
+    def agg(h):
+        return jax.lax.psum(h[0], axis)[None]
+
+    f = jax.jit(shard_map(agg, mesh=jax_mesh, in_specs=(spec,),
+                          out_specs=spec))
+    return np.asarray(f(plane_stacked))[0]

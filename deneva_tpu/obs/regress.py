@@ -36,6 +36,12 @@ INVERTED — amplification growing past (1 + tol) x median means each
 access ships more mesh traffic than it used to, the exact regression the
 remote-grant stickiness work (Config.remote_cache) exists to prevent.
 
+Serve-mode SLO records (bench.py ``--serve``) carry one exact-histogram
+p99 per txn family (``slo_p99[fam*]``); like amplification these gate
+INVERTED — the latency tail GROWING past (1 + tol) x median under the
+same flash-crowd schedule is the regression the SLO plane exists to
+catch.
+
 A gate with no prior data (e.g. per-alg cells first appeared in round 5)
 is SKIPPED with a note, not failed — the gate self-arms as history
 accumulates.  Exit code = number of regressions (0 == clean), wired
@@ -142,6 +148,17 @@ def _entry(source: str, order: tuple, doc: dict) -> Optional[dict]:
         except (TypeError, ValueError):
             continue
     out["adaptive_vs_static"] = avs
+    # serve-mode SLO records (bench.py --serve) carry one exact-histogram
+    # p99 per txn family; gated INVERTED like the amplification cells
+    # (lower is better — the tail GROWING is the regression), self-arming
+    # on the first recorded serve run
+    slo = {}
+    for cell_key, v in (doc.get("slo_p99") or {}).items():
+        try:
+            slo[cell_key] = float(v)
+        except (TypeError, ValueError):
+            continue
+    out["slo_p99"] = slo
     return out
 
 
@@ -313,6 +330,16 @@ def gate(entries: list[dict], current: Optional[dict] = None,
               [e["adaptive_vs_static"][cell_key] for e in prior
                if cell_key in e.get("adaptive_vs_static", {})],
               cpt_tolerance)
+    # serve-mode p99 trajectory (--serve records): INVERTED — the
+    # per-family exact-histogram p99 GROWING past the ceiling means the
+    # same flash-crowd schedule now leaves a fatter latency tail than it
+    # used to, the regression the SLO plane exists to catch; self-arms
+    # once the first serve run lands in the history
+    for cell_key, cur in sorted(current.get("slo_p99", {}).items()):
+        check_ceiling(f"slo_p99[{cell_key}]", cur,
+                      [e["slo_p99"][cell_key] for e in prior
+                       if cell_key in e.get("slo_p99", {})],
+                      cpt_tolerance)
     return {"current": current, "checks": checks, "failures": failures,
             "skipped": skipped}
 

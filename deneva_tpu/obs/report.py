@@ -36,6 +36,13 @@ the full mask (the README watchdog table mirrors it)::
                     against the pre-crash oracle
                     (recovery_replay_ok / recovery_elog_ok = 0,
                     faults/recovery.py)
+    SLO      (128)  Config.slo runs: the run ended with the multi-window
+                    error-budget alert still FIRING (slo_alert_active,
+                    obs/slo.py — both the fast and slow burn windows
+                    above slo_burn_threshold; a drained flash crowd
+                    clears before run end and does not fire), or the
+                    exact histogram-total == committed-txn
+                    reconciliation identity failed
 
 CLI: ``python -m deneva_tpu.obs.report <run_record.json> [--json]``
 exits with the watchdog bitmask, so a CI stage can gate on it
@@ -57,6 +64,7 @@ STARVED = 8
 OVERLOAD = 16
 IMBALANCE = 32
 RECOVERY = 64
+SLO = 128
 
 #: a zero-commit run of at least this many ticks, with abort/admission
 #: churn inside it, is flagged as live-lock
@@ -102,6 +110,15 @@ def reconcile(summary: dict, timeline: dict | None = None) -> list:
       ``warmup_ticks == 0``; callers with warmup pass ``timeline=None``).
     """
     bad = []
+    # SLO histogram plane (Config.slo, obs/histo.py): every committed
+    # measured txn lands in EXACTLY one bucket, so the histogram total
+    # equals the committed-txn count — exactly, no sampling slack
+    if "hist_total_cnt" in summary:
+        got = int(summary["hist_total_cnt"])
+        want = int(summary.get("txn_cnt", 0))
+        if got != want:
+            bad.append(f"histogram: hist_total_cnt={got} != "
+                       f"txn_cnt={want}")
     rc = _reason_counts(summary)
     if rc:
         want = int(summary.get("total_txn_abort_cnt", 0)) \
@@ -188,6 +205,40 @@ def _ctrl_section(summary: dict) -> dict | None:
     }
 
 
+def _slo_section(summary: dict) -> dict | None:
+    """The ``[slo]`` section: the live objective view of a ``Config.slo``
+    run — per-family quantiles routed through the EXACT mergeable
+    histograms (obs/histo.py; not the tail-biased famlat survivor rings),
+    the fast/slow error-budget burn rates, alert state and breach
+    tallies (obs/slo.py SloTracker, merged into the summary by serve-mode
+    callers).  ``None`` (section omitted) when the plane was off."""
+    if "hist_total_cnt" not in summary:
+        return None
+    fams = sorted({int(k[len("slo_fam"):].split("_")[0])
+                   for k in summary if k.startswith("slo_fam")})
+    out = {
+        "families": [{
+            "family": f,
+            "n": int(summary.get(f"slo_fam{f}_n", 0)),
+            "p50": float(summary.get(f"slo_fam{f}_p50", 0.0)),
+            "p95": float(summary.get(f"slo_fam{f}_p95", 0.0)),
+            "p99": float(summary.get(f"slo_fam{f}_p99", 0.0)),
+        } for f in fams],
+        "hist_total": int(summary["hist_total_cnt"]),
+    }
+    if "burn_fast" in summary:
+        out.update({
+            "burn_fast": float(summary["burn_fast"]),
+            "burn_slow": float(summary["burn_slow"]),
+            "served_frac": float(summary.get("burn_served_frac", 1.0)),
+            "abort_rate": float(summary.get("burn_abort_rate", 0.0)),
+            "alert_active": int(summary.get("slo_alert_active", 0)),
+            "alerts": int(summary.get("slo_alert_cnt", 0)),
+            "breach_ticks": int(summary.get("slo_breach_ticks", 0)),
+        })
+    return out
+
+
 def build_report(summary: dict, timeline: dict | None = None,
                  stats: dict | None = None, topk: int = 8,
                  xmeter: dict | None = None,
@@ -252,6 +303,9 @@ def build_report(summary: dict, timeline: dict | None = None,
     ctrl = _ctrl_section(summary)
     if ctrl is not None:
         rep["ctrl"] = ctrl
+    slo = _slo_section(summary)
+    if slo is not None:
+        rep["slo"] = slo
     rep["reconcile_failures"] = reconcile(summary, timeline)
     findings, code = watchdog(summary, timeline,
                               precomputed_reconcile=rep["reconcile_failures"],
@@ -377,6 +431,25 @@ def watchdog(summary: dict, timeline: dict | None = None,
                              f"ticks replayed) — recovery is not "
                              f"deterministic"))
             code |= RECOVERY
+
+    # SLO error-budget alert still firing at run end (Config.slo serve
+    # runs merge obs/slo.py SloTracker fields into the summary): a
+    # drained flash crowd clears the alert before the run ends; a
+    # sustained breach leaves it active.  The exact histogram identity
+    # failing is the same flag — the plane's numbers can't be trusted.
+    if int(summary.get("slo_alert_active", 0)) > 0:
+        findings.append(
+            ("SLO", f"error-budget alert ACTIVE at run end: "
+                    f"burn fast={float(summary.get('burn_fast', 0.0)):.2f}x"
+                    f" slow={float(summary.get('burn_slow', 0.0)):.2f}x "
+                    f"budget ({int(summary.get('slo_breach_ticks', 0))} "
+                    f"ticks in breach over "
+                    f"{int(summary.get('slo_alert_cnt', 0))} alert(s))"))
+        code |= SLO
+    if "hist_total_cnt" in summary and any(
+            f[0] == "RECONCILE" and f[1].startswith("histogram:")
+            for f in findings):
+        code |= SLO
     return findings, code
 
 
@@ -500,6 +573,22 @@ def render_text(rep: dict) -> str:
             lines.append("  backoff bases (ticks): " + " ".join(
                 f"{n}={b}" for n, b in sorted(bases.items(),
                                               key=lambda kv: -kv[1])))
+    if rep.get("slo") is not None:
+        sl = rep["slo"]
+        lines.append(f"[slo] exact-histogram latency objectives "
+                     f"({sl['hist_total']} commits binned)")
+        for fr in sl["families"]:
+            lines.append(
+                f"  fam{fr['family']:<3} n={fr['n']:<8} "
+                f"p50={fr['p50']:<8g} p95={fr['p95']:<8g} "
+                f"p99={fr['p99']:<8g} ticks")
+        if "burn_fast" in sl:
+            state = "FIRING" if sl["alert_active"] else "ok"
+            lines.append(
+                f"  budget burn fast={sl['burn_fast']:.2f}x "
+                f"slow={sl['burn_slow']:.2f}x  served={sl['served_frac']:.3f}"
+                f"  abort_rate={sl['abort_rate']:.3f}  alert={state} "
+                f"({sl['alerts']} fired, {sl['breach_ticks']} breach ticks)")
     for flag, msg in rep["watchdog"]["findings"]:
         lines.append(f"[watchdog] {flag}: {msg}")
     if not rep["watchdog"]["findings"]:

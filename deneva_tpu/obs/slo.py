@@ -1,0 +1,164 @@
+"""Sliding-window SLO engine: error-budget burn-rate alerting over the
+histogram plane (obs/histo.py).
+
+Host-side and allocation-free on device: the tracker consumes periodic
+SNAPSHOTS of the carried ``arr_hist_fam`` plane plus a few cumulative
+counters, and evaluates the objectives on window DELTAS (cumulative
+histograms subtract exactly — int counts — so a window delta is the
+exact histogram of the txns that committed inside the window):
+
+- **latency ceiling**  fraction of window commits whose bucket lies
+  entirely above ``Config.slo_p99_ceiling`` ticks; the error budget is
+  ``1 - slo_target`` (target 0.99 => 1% of commits may breach).  The
+  bucket test is conservative by design: a sample counts as bad only
+  when its whole bucket is past the ceiling.
+- **burn rate**  Google-SRE multi-window form: ``burn = bad_frac /
+  budget`` evaluated over a FAST (``slo_burn_fast`` ticks) and a SLOW
+  (``slo_burn_slow``) window.  The alert FIRES when BOTH exceed
+  ``slo_burn_threshold`` (fast = it is happening now, slow = it is not
+  a blip) and CLEARS when the fast window drops back under — the
+  standard fast-trigger / fast-reset pairing.
+- **served-fraction floor / abort-rate cap**  open-system admission
+  (``queue_admit_cnt / arrival_cnt`` per window) must stay >=
+  ``slo_served_floor``; window aborts per (aborts + commits) must stay
+  <= ``slo_abort_cap``.  Breaches count, they do not gate the alert —
+  the burn rate is the page, these are the dashboard.
+
+``summary_fields()`` surfaces the ``slo_*`` / ``burn_*`` [summary]
+scalars the watchdog bit 128 (obs/report.py) and the stats.py
+passthrough consume; ``events`` keeps the (tick, "fire"/"clear")
+timeline the EXPERIMENTS.md flash-crowd recipe prints.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+import numpy as np
+
+from deneva_tpu.obs import histo as obs_histo
+
+#: cumulative counters the tracker differences per window (all optional
+#: — a closed-loop run has no arrival plane, a NO_WAIT run still has
+#: aborts; missing keys delta to 0)
+COUNTERS = ("txn_cnt", "total_txn_abort_cnt", "arrival_cnt",
+            "queue_admit_cnt")
+
+
+class SloTracker:
+    """Multi-window error-budget tracker over histogram snapshots."""
+
+    def __init__(self, cfg):
+        self.cfg = cfg
+        self.budget = 1.0 - cfg.slo_target
+        # snapshots: (tick, fam_plane copy, counters dict); the deque
+        # only needs to span the slow window plus one poll interval
+        self._snaps: deque = deque()
+        self.events: list = []          # (tick, "fire" | "clear")
+        self.alert_active = False
+        self.alert_cnt = 0
+        self.breach_ticks = 0           # ticks observed with fast burn hot
+        self.served_breach_cnt = 0
+        self.abort_breach_cnt = 0
+        self._last = None               # latest evaluation dict
+
+    # -- feeding -------------------------------------------------------
+
+    def observe(self, tick: int, fam_plane, counters: dict) -> dict:
+        """Ingest one snapshot (host arrays; node-stacked planes are
+        collapsed) and evaluate both windows.  Returns the evaluation
+        dict ({"burn_fast", "burn_slow", "served_frac", "abort_rate",
+        "fired", "cleared"})."""
+        fam = np.asarray(obs_histo._collapse(fam_plane), np.int64)
+        cnt = {k: int(counters.get(k, 0)) for k in COUNTERS}
+        prev_tick = self._snaps[-1][0] if self._snaps else None
+        self._snaps.append((int(tick), fam.copy(), cnt))
+        horizon = int(tick) - self.cfg.slo_burn_slow
+        while len(self._snaps) > 2 and self._snaps[1][0] <= horizon:
+            self._snaps.popleft()
+
+        fast = self._window(tick, self.cfg.slo_burn_fast)
+        slow = self._window(tick, self.cfg.slo_burn_slow)
+        served = self._served(fast)
+        abort_rate = self._abort_rate(fast)
+        burn_fast, burn_slow = fast["burn"], slow["burn"]
+
+        fired = cleared = False
+        hot = (burn_fast > self.cfg.slo_burn_threshold)
+        if hot and prev_tick is not None:
+            self.breach_ticks += int(tick) - int(prev_tick)
+        if not self.alert_active and hot \
+                and burn_slow > self.cfg.slo_burn_threshold:
+            self.alert_active, fired = True, True
+            self.alert_cnt += 1
+            self.events.append((int(tick), "fire"))
+        elif self.alert_active and not hot:
+            self.alert_active, cleared = False, True
+            self.events.append((int(tick), "clear"))
+        if served < self.cfg.slo_served_floor:
+            self.served_breach_cnt += 1
+        if abort_rate > self.cfg.slo_abort_cap:
+            self.abort_breach_cnt += 1
+
+        self._last = {"tick": int(tick), "burn_fast": burn_fast,
+                      "burn_slow": burn_slow, "served_frac": served,
+                      "abort_rate": abort_rate, "fired": fired,
+                      "cleared": cleared,
+                      "window_commits": int(fast["total"])}
+        return self._last
+
+    # -- window math ---------------------------------------------------
+
+    def _base(self, tick: int, window: int):
+        """Most recent snapshot at or before ``tick - window`` (falls
+        back to the oldest — a young tracker evaluates what it has)."""
+        base = self._snaps[0]
+        for s in self._snaps:
+            if s[0] <= int(tick) - window:
+                base = s
+            else:
+                break
+        return base
+
+    def _window(self, tick: int, window: int) -> dict:
+        now = self._snaps[-1]
+        base = self._base(tick, window)
+        delta = now[1] - base[1]
+        total = int(delta.sum())
+        lows = obs_histo.bucket_lows(delta.shape[-1])
+        bad = int(delta[:, lows > self.cfg.slo_p99_ceiling].sum())
+        frac = bad / total if total > 0 else 0.0
+        return {"total": total, "bad": bad, "frac": frac,
+                "burn": frac / self.budget, "delta": delta,
+                "base_tick": base[0],
+                "counters": {k: now[2][k] - base[2][k] for k in COUNTERS}}
+
+    @staticmethod
+    def _served(win: dict) -> float:
+        c = win["counters"]
+        arrived = c["arrival_cnt"]
+        return (c["queue_admit_cnt"] / arrived) if arrived > 0 else 1.0
+
+    @staticmethod
+    def _abort_rate(win: dict) -> float:
+        c = win["counters"]
+        done = c["total_txn_abort_cnt"] + c["txn_cnt"]
+        return (c["total_txn_abort_cnt"] / done) if done > 0 else 0.0
+
+    # -- surfacing -----------------------------------------------------
+
+    def summary_fields(self) -> dict:
+        """[summary] scalars: ``slo_*`` counters verbatim ints,
+        ``burn_*`` dimensionless floats (stats.py passthrough rules)."""
+        last = self._last or {}
+        return {
+            "slo_alert_active": int(self.alert_active),
+            "slo_alert_cnt": int(self.alert_cnt),
+            "slo_breach_ticks": int(self.breach_ticks),
+            "slo_served_breach_cnt": int(self.served_breach_cnt),
+            "slo_abort_breach_cnt": int(self.abort_breach_cnt),
+            "burn_fast": float(last.get("burn_fast", 0.0)),
+            "burn_slow": float(last.get("burn_slow", 0.0)),
+            "burn_served_frac": float(last.get("served_frac", 1.0)),
+            "burn_abort_rate": float(last.get("abort_rate", 0.0)),
+        }

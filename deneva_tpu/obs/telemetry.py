@@ -1,0 +1,186 @@
+"""Streaming telemetry exporter: the zero-retrace serve-mode bridge.
+
+``TelemetryExporter.poll(state, tick)`` snapshots the carried stats
+WITHOUT entering the jit path — pure ``np.asarray`` device reads, no
+traced function is called, so a poll can never recompile the tick (the
+serve loop proves this under the obs/xmeter.py sentinel).  Each poll:
+
+- feeds the SLO tracker (obs/slo.py) one histogram snapshot;
+- appends one JSON object to the append-only ``telemetry.jsonl``
+  stream (tick, per-family n/p50/p95/p99 from the EXACT histograms,
+  burn rates, served fraction, abort rate, alert state);
+- atomically rewrites the OpenMetrics text exposition
+  (``metrics.om``): one ``histogram`` family over the log buckets
+  (cumulative ``_bucket{le=...}`` samples; ``_sum`` is approximated
+  from bucket midpoints and documented as such — the quantiles come
+  from the buckets, never from ``_sum``), burn-rate / alert gauges and
+  the commit counter, ``# EOF``-terminated per the spec.
+
+Quantiles here are derived from the histogram plane, NOT the famlat
+survivor rings — the rings keep only the last ``fam_lat_samples``
+commits per family and bias the tail once arrivals outrun them
+(README "Live SLO & telemetry" documents the bias window).
+
+``parse_openmetrics`` is the matching reader used by the round-trip
+test and the scripts/check.sh telemetry smoke.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+from deneva_tpu.obs import histo as obs_histo
+from deneva_tpu.obs import slo as obs_slo
+
+#: exposition metric names (the ``deneva_`` namespace)
+HIST_METRIC = "deneva_latency_ticks"
+BURN_METRIC = "deneva_slo_burn_rate"
+ALERT_METRIC = "deneva_slo_alert_active"
+COMMITS_METRIC = "deneva_commits"
+
+JSONL_SCHEMA = 1
+
+
+def _scalar(stats: dict, key: str) -> int:
+    """Cumulative counter as a host int; node-stacked sharded scalars
+    ((N,) arrays) sum exactly."""
+    if key not in stats:
+        return 0
+    return int(np.asarray(stats[key]).sum())
+
+
+class TelemetryExporter:
+    """Host-side streaming exporter around one engine's state."""
+
+    def __init__(self, cfg, out_dir: str, tracker=None):
+        self.cfg = cfg
+        self.out_dir = out_dir
+        self.tracker = tracker if tracker is not None \
+            else obs_slo.SloTracker(cfg)
+        os.makedirs(out_dir, exist_ok=True)
+        self.jsonl_path = os.path.join(out_dir, "telemetry.jsonl")
+        self.om_path = os.path.join(out_dir, "metrics.om")
+        self.polls = 0
+
+    # -- the poll ------------------------------------------------------
+
+    def poll(self, state, tick: int) -> dict:
+        """Snapshot -> track -> stream.  Returns the JSONL record."""
+        stats = state.stats
+        fam = obs_histo._collapse(stats["arr_hist_fam"])
+        counters = {k: _scalar(stats, k) for k in obs_slo.COUNTERS}
+        ev = self.tracker.observe(tick, fam, counters)
+        rec = {"schema": JSONL_SCHEMA, "tick": int(tick),
+               "poll": self.polls,
+               "commits": counters["txn_cnt"],
+               "hist_total": int(fam.sum()),
+               "fam": {}}
+        for f in range(fam.shape[0]):
+            rec["fam"][str(f)] = {
+                "n": int(fam[f].sum()),
+                **{f"p{p}": obs_histo.quantile(fam[f], p / 100.0)
+                   for p in obs_histo.SLO_PCTS}}
+        rec.update({k: ev[k] for k in ("burn_fast", "burn_slow",
+                                       "served_frac", "abort_rate")})
+        rec["alert_active"] = int(self.tracker.alert_active)
+        if ev["fired"]:
+            rec["event"] = "fire"
+        elif ev["cleared"]:
+            rec["event"] = "clear"
+        with open(self.jsonl_path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        self._write_openmetrics(fam, rec)
+        self.polls += 1
+        return rec
+
+    # -- OpenMetrics exposition ----------------------------------------
+
+    def _write_openmetrics(self, fam: np.ndarray, rec: dict) -> None:
+        lines = []
+        F, bins = fam.shape
+        lows = obs_histo.bucket_lows(bins)
+        widths = obs_histo.bucket_widths(bins)
+        highs = lows + widths - 1            # inclusive upper bounds
+        lines.append(f"# TYPE {HIST_METRIC} histogram")
+        lines.append(f"# UNIT {HIST_METRIC} ticks")
+        lines.append(f"# HELP {HIST_METRIC} commit latency (first "
+                     "start -> commit) per txn family; log buckets, "
+                     "_sum approximated from bucket midpoints")
+        for f in range(F):
+            cum = np.cumsum(fam[f])
+            last = int(np.max(np.nonzero(fam[f])[0])) \
+                if fam[f].any() else 0
+            for b in range(last + 1):
+                lines.append(
+                    f'{HIST_METRIC}_bucket{{family="{f}",'
+                    f'le="{int(highs[b])}"}} {int(cum[b])}')
+            n = int(fam[f].sum())
+            lines.append(f'{HIST_METRIC}_bucket{{family="{f}",'
+                         f'le="+Inf"}} {n}')
+            lines.append(f'{HIST_METRIC}_count{{family="{f}"}} {n}')
+            approx = float((fam[f] * (lows + (widths - 1) / 2)).sum())
+            lines.append(f'{HIST_METRIC}_sum{{family="{f}"}} {approx:g}')
+        lines.append(f"# TYPE {BURN_METRIC} gauge")
+        lines.append(f'{BURN_METRIC}{{window="fast"}} '
+                     f'{rec["burn_fast"]:g}')
+        lines.append(f'{BURN_METRIC}{{window="slow"}} '
+                     f'{rec["burn_slow"]:g}')
+        lines.append(f"# TYPE {ALERT_METRIC} gauge")
+        lines.append(f"{ALERT_METRIC} {rec['alert_active']}")
+        lines.append(f"# TYPE {COMMITS_METRIC} counter")
+        lines.append(f"{COMMITS_METRIC}_total {rec['commits']}")
+        lines.append("# EOF")
+        tmp = self.om_path + ".tmp"
+        with open(tmp, "w") as fh:
+            fh.write("\n".join(lines) + "\n")
+        os.replace(tmp, self.om_path)
+
+
+# ---------------------------------------------------------------------------
+# the matching reader (round-trip test + check.sh smoke)
+# ---------------------------------------------------------------------------
+
+def parse_openmetrics(text: str) -> dict:
+    """Minimal OpenMetrics text parser for the exporter's own output:
+    returns {"types": {name: type}, "samples": [(name, labels, value)],
+    "eof": bool}."""
+    types, samples, eof = {}, [], False
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line == "# EOF":
+            eof = True
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, mtype = line.split(None, 3)
+            types[name] = mtype
+            continue
+        if line.startswith("#"):
+            continue
+        head, _, val = line.rpartition(" ")
+        labels = {}
+        if "{" in head:
+            name, _, lab = head.partition("{")
+            for part in lab.rstrip("}").split(","):
+                if not part:
+                    continue
+                k, _, v = part.partition("=")
+                labels[k] = v.strip('"')
+        else:
+            name = head
+        samples.append((name, labels, float(val)))
+    return {"types": types, "samples": samples, "eof": eof}
+
+
+def sample_value(parsed: dict, name: str, **labels):
+    """First sample matching ``name`` and every given label (None when
+    absent)."""
+    for n, lab, v in parsed["samples"]:
+        if n == name and all(lab.get(k) == str(w)
+                             for k, w in labels.items()):
+            return v
+    return None

@@ -181,6 +181,34 @@ def record_ctrl(stats: dict, t) -> dict:
                 row, unique_indices=True)}
 
 
+def record_slo(cfg, stats: dict, t) -> dict:
+    """Record the SLO plane's per-family device-side gauges — the
+    bucket-low p99 estimate (ticks) and the CUMULATIVE error-budget
+    burn rate x1000 (obs/histo.py fixed point) — into the SLO ring
+    (columns ``[p99_f0..p99_fF-1, burn_f0..burn_fF-1]``).  Gauges under
+    the same wrap-and-accumulate discipline (and caveat) as
+    :func:`record_ctrl`; the bucket lows and the over-ceiling mask are
+    baked trace constants, so the series costs zero recompiles.  No-op
+    unless the run traces with ``Config.slo``."""
+    if "arr_slo_trace" not in stats:
+        return stats
+    from deneva_tpu.obs import histo as obs_histo
+    buf = stats["arr_slo_trace"]
+    fam = stats["arr_hist_fam"]
+    F, bins = fam.shape
+    lows_np = obs_histo.bucket_lows(bins)
+    lows = jnp.asarray(lows_np, jnp.int32)
+    over = jnp.asarray((lows_np > cfg.slo_p99_ceiling).astype(np.int32))
+    budget = 1.0 - cfg.slo_target
+    row = jnp.stack(
+        [obs_histo.device_quantile(fam[f], lows, 0.99) for f in range(F)]
+        + [obs_histo.device_burn_milli(fam[f], over, budget)
+           for f in range(F)]).astype(jnp.int32)
+    return {**stats,
+            "arr_slo_trace": buf.at[t % buf.shape[0]].add(
+                row, unique_indices=True)}
+
+
 def _buffer(state_or_stats) -> np.ndarray:
     stats = getattr(state_or_stats, "stats", state_or_stats)
     assert "arr_trace" in stats, "run with Config.trace_ticks > 0"
@@ -215,6 +243,21 @@ def _ctrl_buffer(state_or_stats) -> np.ndarray | None:
     return np.asarray(stats["arr_ctrl_trace"])
 
 
+def _slo_buffer(state_or_stats) -> np.ndarray | None:
+    stats = getattr(state_or_stats, "stats", state_or_stats)
+    if "arr_slo_trace" not in stats:
+        return None
+    return np.asarray(stats["arr_slo_trace"])
+
+
+def _slo_names(n_cols: int) -> tuple:
+    """Series names for the (T, 2F) SLO ring: p99 gauges then burn
+    gauges, one per family (``slo_f{f}_p99`` / ``slo_f{f}_burn``)."""
+    F = n_cols // 2
+    return tuple([f"slo_f{f}_p99" for f in range(F)]
+                 + [f"slo_f{f}_burn" for f in range(F)])
+
+
 def _reason_names() -> tuple:
     from deneva_tpu.cc.base import ABORT_REASONS
     return tuple(f"abort_{name}" for name in ABORT_REASONS)
@@ -233,12 +276,14 @@ def timeline(state_or_stats, per_shard: bool = False) -> dict:
     q = _queue_buffer(state_or_stats)
     m = _mesh_buffer(state_or_stats)      # stacked: (N, trace_ticks, N)
     c = _ctrl_buffer(state_or_stats)
+    sl = _slo_buffer(state_or_stats)
     if a.ndim == 3 and not per_shard:
         a = a.sum(axis=0)
         r = r.sum(axis=0) if r is not None else None
         q = q.sum(axis=0) if q is not None else None
         m = m.sum(axis=0) if m is not None else None
         c = c.sum(axis=0) if c is not None else None
+        sl = sl.sum(axis=0) if sl is not None else None
     if a.ndim == 3:
         out = {name: a[:, :, i] for i, name in enumerate(TRACE_COLUMNS)}
         if r is not None:
@@ -252,6 +297,9 @@ def timeline(state_or_stats, per_shard: bool = False) -> dict:
         if c is not None:
             out.update({f"ctrl_{name}": c[:, :, i]
                         for i, name in enumerate(CTRL_COLUMNS)})
+        if sl is not None:
+            out.update({name: sl[:, :, i] for i, name
+                        in enumerate(_slo_names(sl.shape[-1]))})
         return out
     out = {name: a[:, i] for i, name in enumerate(TRACE_COLUMNS)}
     if r is not None:
@@ -264,6 +312,9 @@ def timeline(state_or_stats, per_shard: bool = False) -> dict:
     if c is not None:
         out.update({f"ctrl_{name}": c[:, i]
                     for i, name in enumerate(CTRL_COLUMNS)})
+    if sl is not None:
+        out.update({name: sl[:, i] for i, name
+                    in enumerate(_slo_names(sl.shape[-1]))})
     return out
 
 
@@ -322,6 +373,10 @@ def to_chrome_trace(state_or_stats, path: str, n_ticks: int | None = None,
     cshards = None
     if cbuf is not None:
         cshards = cbuf[None] if cbuf.ndim == 2 else cbuf
+    sbuf = _slo_buffer(state_or_stats)
+    sshards = None
+    if sbuf is not None:
+        sshards = sbuf[None] if sbuf.ndim == 2 else sbuf
     rnames = _reason_names()
     N, T, _ = shards.shape
     if n_ticks is not None:
@@ -382,6 +437,17 @@ def to_chrome_trace(state_or_stats, path: str, n_ticks: int | None = None,
                                "args": {c: int(cshards[node][t, i])
                                         for i, c in
                                         enumerate(CTRL_COLUMNS)}})
+            if sshards is not None:
+                # 9th counter track (same conditional discipline): the
+                # SLO plane's per-family p99 estimate (ticks) and
+                # cumulative burn-rate x1000 gauges (Config.slo with
+                # tracing; obs/histo.py)
+                events.append({"name": "slo burn rate", "ph": "C",
+                               "ts": ts, "pid": node,
+                               "args": {c: int(sshards[node][t, i])
+                                        for i, c in enumerate(
+                                            _slo_names(
+                                                sshards.shape[-1]))}})
     xentries = []
     if xmeter:
         # 5th counter track, present only when an xmeter snapshot is
@@ -418,6 +484,8 @@ def to_chrome_trace(state_or_stats, path: str, n_ticks: int | None = None,
         doc["metadata"]["mesh_track_nodes"] = int(mshards.shape[-1])
     if cshards is not None:
         doc["metadata"]["ctrl_track"] = list(CTRL_COLUMNS)
+    if sshards is not None:
+        doc["metadata"]["slo_track"] = list(_slo_names(sshards.shape[-1]))
     if xentries:
         doc["metadata"]["xmeter_entries"] = xentries
     if flight:

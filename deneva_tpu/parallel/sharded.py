@@ -64,6 +64,7 @@ from deneva_tpu.engine.scheduler import (STAT_KEYS_F32, STAT_KEYS_I32,  # noqa: 
                                          track_state_latencies)
 from deneva_tpu.faults import plan as fault_plan
 from deneva_tpu.obs import flight as obs_flight
+from deneva_tpu.obs import histo as obs_histo
 from deneva_tpu.obs import mesh as obs_mesh
 from deneva_tpu.obs import trace as obs_trace
 from deneva_tpu.obs.prog import ProgressEmitter
@@ -1495,6 +1496,7 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
             stats = obs_trace.record_reasons(stats, t)
             stats = obs_trace.record_queue(stats, t)
             stats = obs_trace.record_ctrl(stats, t)
+            stats = obs_trace.record_slo(cfg, stats, t)
             # per-dest sent counts into the mesh companion ring (the
             # per-node-pair Perfetto counter tracks; obs/mesh.py)
             stats = obs_mesh.note_trace(stats, t, mesh_per_dest)
@@ -1922,6 +1924,15 @@ class ShardedEngine:
             # backlog-pressure bound, not a max)
             out.update(traffic.family_percentiles(
                 state.stats["arr_fam_lat"], state.stats["arr_fam_cursor"]))
+        if "arr_hist_fam" in state.stats:
+            # SLO histogram plane (obs/histo.py): the node-stacked
+            # (N, F, BINS) planes merge by EXACT int sum — the cluster
+            # histogram equals every shard's histogram added elementwise
+            # (hist_cluster_plane proves bit-parity on device), so the
+            # cluster quantiles are exact where the famlat ring view
+            # above concatenates biased per-node survivor suffixes
+            out.update(obs_histo.summary_keys(
+                state.stats["arr_hist_fam"], state.stats["arr_hist_phase"]))
         if wall_seconds is not None:
             out["tput"] = s["txn_cnt"] / wall_seconds
         if self.xmeter is not None:
@@ -1951,6 +1962,13 @@ class ShardedEngine:
         bit-exact equal to the host sum of the per-node tx planes."""
         return obs_mesh.cluster_matrix(self.mesh,
                                        state.stats["arr_mesh_tx"])
+
+    def hist_cluster_plane(self, state: ShardState,
+                           key: str = "arr_hist_fam") -> np.ndarray:
+        """Device-psum'd cluster latency histogram (obs/histo.py) —
+        bit-exact equal to the host ``sum(axis=0)`` of the node-stacked
+        per-shard planes (exact merge: elementwise int32 add)."""
+        return obs_histo.cluster_plane(self.mesh, state.stats[key])
 
     def ledger(self, state: ShardState) -> list:
         """Cluster HBM footprint rows (obs/xmeter.py state_ledger): the

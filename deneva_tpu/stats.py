@@ -195,6 +195,19 @@ def reference_summary(s: dict, wall_seconds: float | None = None) -> dict:
     for k in sorted(s):
         if k.startswith("famlat") and k not in out:
             out[k] = s[k] * tick_sec if isinstance(s[k], float) else s[k]
+    # SLO / telemetry plane keys (Config.slo, obs/histo.py + obs/slo.py):
+    # hist_* reconciliation totals and burn_* burn-rate gauges pass
+    # through verbatim (counts and dimensionless ratios — never
+    # time-scaled); slo_* follows the famlat rule — the float quantiles
+    # are tick-valued latencies that scale by tick_sec, the int counters
+    # (sample counts, alert/breach tallies) pass through verbatim.
+    # Present only when the plane is on, so the default line stays
+    # byte-identical.
+    for k in sorted(s):
+        if k.startswith(("hist_", "burn_")) and k not in out:
+            out[k] = s[k]
+        elif k.startswith("slo_") and k not in out:
+            out[k] = s[k] * tick_sec if isinstance(s[k], float) else s[k]
     # reference-name ALIASES for the invented chain counters, so parsers
     # of reference-format summaries (stats.cpp:907 prints case1..6) keep
     # their maat_caseN_cnt fields.  The reference's case2/4/5 fire against

@@ -45,6 +45,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deneva_tpu.obs import histo as obs_histo
+
 #: famlat{f}_p{P} summary percentiles (the open-system SLO view: p50 for
 #: the median user, p95/p99 for the tail the paper's knee methodology
 #: cares about)
@@ -190,7 +192,14 @@ def record_family_latency(stats: dict, commit, txn_type, lat,
     engine/scheduler.py record_commit_latency: survivors of a sequential
     append occupy distinct in-ring positions mod S, dead lanes map to
     DISTINCT out-of-bounds cells (LINT.md scatter rules).  No-op when
-    the arrival plane is off."""
+    the arrival plane is off.
+
+    The SLO histogram plane (obs/histo.py, ``Config.slo``) hooks in
+    FIRST — it counts every commit exactly (no ring, no bias) and works
+    closed-loop too, so it must not sit behind the arrival-plane early
+    return."""
+    stats = obs_histo.record_commit(stats, commit, txn_type, lat,
+                                    measuring)
     if "arr_fam_lat" not in stats:
         return stats
     ring, cur = stats["arr_fam_lat"], stats["arr_fam_cursor"]

@@ -400,6 +400,50 @@ if [ "$adapt_rc" -ne 0 ]; then
     exit "$adapt_rc"
 fi
 
+echo "== telemetry smoke (serve loop + SLO alert lifecycle) =="
+# the streaming telemetry plane (deneva_tpu/obs/{histo,slo,telemetry}.py)
+# end to end: the flash-crowd serve loop must run with ZERO steady-state
+# recompiles, the exact-histogram reconciliation identity must hold, the
+# burn-rate alert must FIRE inside the crowd and CLEAR after the drain
+# (a stuck alert is the SLO watchdog bit 128 -> nonzero exit), and the
+# exported OpenMetrics/JSONL artifacts must parse and reconcile against
+# the serve record
+slo_dir=$(mktemp -d)
+env JAX_PLATFORMS=cpu python bench.py --serve --no-history \
+    --out-dir "$slo_dir"
+slo_rc=$?
+if [ "$slo_rc" -eq 0 ]; then
+    env JAX_PLATFORMS=cpu python - "$slo_dir" <<'PYEOF'
+import json, os, sys
+from deneva_tpu.obs import telemetry
+d = sys.argv[1]
+doc = json.load(open(os.path.join(d, "serve_slo.json")))
+assert doc["metric"] == "serve_slo", doc["metric"]
+assert doc["watchdog"] == 0 and doc["steady_recompiles"] == 0, doc
+kinds = [e[1] for e in doc["alerts"]]
+assert kinds and kinds[0] == "fire" and "clear" in kinds, doc["alerts"]
+assert kinds[-1] == "clear", "alert still firing at run end"
+recs = [json.loads(ln) for ln in
+        open(os.path.join(d, "telemetry.jsonl"))]
+assert [r["poll"] for r in recs] == list(range(len(recs)))
+assert all(r["schema"] == telemetry.JSONL_SCHEMA for r in recs)
+om = telemetry.parse_openmetrics(
+    open(os.path.join(d, "metrics.om")).read())
+assert om["eof"], "OpenMetrics exposition not EOF-terminated"
+cnt = telemetry.sample_value(
+    om, f"{telemetry.HIST_METRIC}_count", family=0)
+assert cnt == recs[-1]["hist_total"], (cnt, recs[-1]["hist_total"])
+print(f"[telemetry] p99={doc['value']} alerts={doc['alerts']} "
+      f"breach_ticks={doc['breach_ticks']} polls={len(recs)}")
+PYEOF
+    slo_rc=$?
+fi
+rm -rf "$slo_dir"
+if [ "$slo_rc" -ne 0 ]; then
+    echo "telemetry smoke FAILED (serve/reconcile/export rc=$slo_rc)"
+    exit "$slo_rc"
+fi
+
 echo "== bench regression gate =="
 # gate the latest trajectory point (committed BENCH_r*.json snapshots +
 # any results/bench_history.jsonl) against the median of its priors;

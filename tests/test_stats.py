@@ -293,3 +293,47 @@ def test_scale_out_keys_round_trip_exactly():
     assert not any(k.startswith(("exchange_", "remote_attempt_",
                                  "remote_cache_", "reship_"))
                    for k in p0)
+
+
+def test_slo_keys_round_trip_exactly():
+    """SLO-plane runs (Config.slo, obs/histo.py + obs/slo.py) put the
+    exact-histogram percentiles and the error-budget fields on the
+    [summary] line: the hist_*/burn_* keys pass through VERBATIM (counts
+    and dimensionless burn rates, never time-scaled), the slo_fam*
+    percentiles are tick-valued latencies and scale with wall seconds
+    famlat-style while the slo_* counters stay integral, everything
+    round-trips through the parser port, and the default line carries
+    none of them."""
+    eng, st = run_engine(slo=True, arrival="poisson", arrival_rate=6.0)
+    s = eng.summary(st)
+    # host-side tracker fields ride the same line (bench.py --serve
+    # merges SloTracker.summary_fields() before formatting)
+    host = {"slo_alert_cnt": 2, "slo_alert_active": 0,
+            "slo_breach_ticks": 40, "slo_served_breach_cnt": 1,
+            "slo_abort_breach_cnt": 0, "burn_fast": 0.0,
+            "burn_slow": 1.5, "burn_served_frac": 0.98,
+            "burn_abort_rate": 0.12}
+    d1 = stats_mod.reference_summary({**s, **host})
+    d2 = stats_mod.reference_summary({**s, **host},
+                                     wall_seconds=s["measured_ticks"]
+                                     * 2.0)
+    # percentiles scale like famlat/ccl*; counts and burn rates never
+    assert abs(d2["slo_fam0_p99"] - 2.0 * d1["slo_fam0_p99"]) < 1e-6
+    for k in ("slo_fam0_n", "hist_total_cnt", "hist_phase_cnt",
+              "slo_alert_cnt", "slo_breach_ticks"):
+        assert d2[k] == d1[k] == (s | host)[k], k
+    for k in ("burn_fast", "burn_slow", "burn_served_frac",
+              "burn_abort_rate"):
+        assert d2[k] == d1[k] == host[k], k
+    # exact-name round trip through the parser port
+    parsed = stats_mod.parse_summary(stats_mod.format_summary(d1))
+    for k in list(host) + ["hist_total_cnt", "hist_phase_cnt",
+                           "slo_fam0_n", "slo_fam0_p50", "slo_fam0_p95",
+                           "slo_fam0_p99"]:
+        assert parsed[k] == pytest.approx(d1[k]), k
+    # the reconciliation identity survives the round trip
+    assert parsed["hist_total_cnt"] == parsed["txn_cnt"]
+    # the default (slo-off) line carries none of them
+    eng0, st0 = run_engine()
+    p0 = stats_mod.parse_summary(eng0.summary_line(st0, wall_seconds=1.0))
+    assert not any(k.startswith(("slo_", "hist_", "burn_")) for k in p0)

@@ -1,0 +1,513 @@
+"""Live SLO & streaming telemetry plane tests (obs/histo.py, obs/slo.py,
+obs/telemetry.py, ``Config.slo``).
+
+The plane's two exact reconciliation identities under test:
+
+- ``hist_total_cnt == txn_cnt`` — every committed measured txn lands in
+  exactly one log bucket (same take mask as the commit counter), for
+  every CC plugin;
+- cluster histogram == elementwise sum of per-shard planes, BIT-equal —
+  int32 counts merge exactly (associative, commutative), which is the
+  property the famlat survivor rings fundamentally lack (they keep the
+  last S commits per family and BIAS the tail once arrivals outrun
+  them; the divergence test below demonstrates it).
+
+Plus the off-path contract (``Config.slo`` off adds zero carry arrays
+and zero summary keys and perturbs no shared counter), the multi-window
+burn-rate alert lifecycle on a synthetic rate step, the OpenMetrics /
+JSONL round-trip, the Perfetto "slo burn rate" track, the self-arming
+regress ceiling, and the zero-post-warm-recompile serve smoke under the
+xmeter sentinel.
+"""
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deneva_tpu import stats as stats_mod
+from deneva_tpu import traffic
+from deneva_tpu.config import Config
+from deneva_tpu.engine.scheduler import Engine
+from deneva_tpu.obs import histo as obs_histo
+from deneva_tpu.obs import report as obs_report
+from deneva_tpu.obs import slo as obs_slo
+from deneva_tpu.obs import telemetry as obs_telemetry
+from deneva_tpu.obs import trace as obs_trace
+
+BASE = dict(cc_alg="NO_WAIT", batch_size=64, synth_table_size=1 << 10,
+            req_per_query=4, zipf_theta=0.6, query_pool_size=1 << 10,
+            warmup_ticks=0)
+
+# MAAT's interval-validation compile dominates the suite's wall clock
+# (PR 11 precedent: tier-1 MAAT coverage lives in test_maat.py)
+ALGS = ("NO_WAIT", "WAIT_DIE", "TIMESTAMP", "MVCC", "OCC",
+        pytest.param("MAAT", marks=pytest.mark.slow), "CALVIN")
+
+#: the EXACT extra [summary] keys one live family adds (the off-path
+#: identity test asserts this set, nothing more, nothing less)
+EXTRA_SUMMARY_KEYS = {"hist_total_cnt", "hist_phase_cnt", "slo_fam0_n",
+                      "slo_fam0_p50", "slo_fam0_p95", "slo_fam0_p99"}
+EXTRA_STATS_KEYS = {"arr_hist_fam", "arr_hist_phase"}
+
+
+# ---------------------------------------------------------------------------
+# bucket math
+# ---------------------------------------------------------------------------
+
+def test_bucket_math_exact_small_monotone_and_bounded_error():
+    bins = 96
+    lows = obs_histo.bucket_lows(bins)
+    widths = obs_histo.bucket_widths(bins)
+    vals = np.arange(0, int(lows[-1]) + 5)
+    b = np.asarray(obs_histo.bucket_of(jnp.asarray(vals), bins))
+    # monotone, in range
+    assert (np.diff(b) >= 0).all()
+    assert b.min() == 0 and b.max() == bins - 1
+    # every value lies inside its bucket (until the clamp bucket)
+    inside = b < bins - 1
+    assert (vals[inside] >= lows[b[inside]]).all()
+    assert (vals[inside] < lows[b[inside]] + widths[b[inside]]).all()
+    # values < 16 bucket exactly (one bucket per integer)
+    assert (b[:16] == np.arange(16)).all()
+    # relative bucket width bounded: <= 1/8 of the bucket's low after
+    # the exact range (the HDR guarantee the quantiles inherit)
+    big = lows >= 16
+    assert (widths[big] / lows[big] <= 0.125 + 1e-9).all()
+    # negative / zero clamp to bucket 0
+    nb = np.asarray(obs_histo.bucket_of(jnp.asarray([-5, 0]), bins))
+    assert (nb == 0).all()
+
+
+def test_quantile_host_and_device_agree():
+    bins = 64
+    rng = np.random.default_rng(7)
+    # keep the population inside the 64-bin reach (960 ticks) so no
+    # sample hits the clamp bucket and quantiles stay meaningful
+    vals = rng.integers(0, 900, size=5000)
+    b = np.asarray(obs_histo.bucket_of(jnp.asarray(vals), bins))
+    hist = np.bincount(b, minlength=bins).astype(np.int64)
+    lows = obs_histo.bucket_lows(bins)
+    for q in (0.5, 0.95, 0.99):
+        hq = obs_histo.quantile(hist, q)
+        dq = float(obs_histo.device_quantile(
+            jnp.asarray(hist, jnp.int32), jnp.asarray(lows, jnp.int32), q))
+        # device returns the bucket LOW, host the bucket midpoint value
+        assert abs(hq - dq) <= obs_histo.bucket_widths(bins)[
+            int(np.searchsorted(lows, dq, side="right")) - 1]
+        # within one bucket of numpy's exact quantile
+        exact = float(np.quantile(vals, q, method="inverted_cdf"))
+        assert hq >= exact * 0.85 and hq <= exact * 1.15
+    # empty histogram -> 0
+    assert obs_histo.quantile(np.zeros(bins, np.int64), 0.99) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# exact reconciliation: histogram total == commits, per plugin
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("alg", ALGS)
+def test_hist_total_equals_commits_every_plugin(alg):
+    cfg = Config(**{**BASE, "cc_alg": alg}, slo=True,
+                 arrival="poisson", arrival_rate=8.0)
+    eng = Engine(cfg)
+    st = eng.run(40)
+    s = eng.summary(st)
+    assert s["txn_cnt"] > 0, "cell committed nothing"
+    assert s["hist_total_cnt"] == s["txn_cnt"], (alg, s["hist_total_cnt"],
+                                                 s["txn_cnt"])
+    # the per-family sample counts partition the total
+    assert s["slo_fam0_n"] == s["hist_total_cnt"]
+    # phase plane: each phase row is a per-tick occupancy histogram, so
+    # every row sums to the measured tick count
+    ph = np.asarray(st.stats["arr_hist_phase"])
+    rows = ph.sum(axis=1)
+    assert (rows == rows[0]).all()
+    assert s["hist_phase_cnt"] == int(rows.sum())
+
+
+def test_hist_works_closed_loop():
+    # no arrival plane at all: the histogram hook sits BEFORE the
+    # arrival-plane early return, so closed-loop runs still bin commits
+    cfg = Config(**BASE, slo=True)
+    eng = Engine(cfg)
+    st = eng.run(30)
+    s = eng.summary(st)
+    assert s["txn_cnt"] > 0
+    assert s["hist_total_cnt"] == s["txn_cnt"]
+    assert "arrival_cnt" not in s
+
+
+# ---------------------------------------------------------------------------
+# off-path contract
+# ---------------------------------------------------------------------------
+
+def test_off_path_adds_nothing_and_on_path_adds_exactly():
+    on = Config(**BASE, slo=True, arrival="poisson", arrival_rate=8.0)
+    off = Config(**BASE, arrival="poisson", arrival_rate=8.0)
+    e_on, e_off = Engine(on), Engine(off)
+    st_on, st_off = e_on.run(30), e_off.run(30)
+    s_on, s_off = e_on.summary(st_on), e_off.summary(st_off)
+    # off: zero carry arrays, zero summary keys
+    assert not any(k.startswith(("arr_hist", "arr_slo")) for k in
+                   st_off.stats)
+    assert not any(k.startswith(("hist_", "slo_", "burn_")) for k in s_off)
+    # on: EXACTLY the documented key sets
+    assert set(st_on.stats) - set(st_off.stats) == EXTRA_STATS_KEYS
+    assert set(s_on) - set(s_off) == EXTRA_SUMMARY_KEYS
+    # the plane is observational: every shared counter is bit-identical
+    for k in s_off:
+        if isinstance(s_off[k], float):
+            assert s_on[k] == pytest.approx(s_off[k]), k
+        else:
+            assert s_on[k] == s_off[k], k
+
+
+def test_slo_config_validation():
+    with pytest.raises(AssertionError):
+        Config(**BASE, slo=True, slo_hist_bins=20)     # not a multiple of 8
+    with pytest.raises(AssertionError):
+        Config(**BASE, slo=True, slo_target=1.5)
+    with pytest.raises(AssertionError):
+        Config(**BASE, slo=True, slo_burn_fast=50, slo_burn_slow=5)
+    with pytest.raises(AssertionError):
+        Config(**BASE, slo=True, slo_export_interval=0)
+
+
+# ---------------------------------------------------------------------------
+# merge exactness
+# ---------------------------------------------------------------------------
+
+def test_merge_exact_and_associative():
+    bins = 32
+    rng = np.random.default_rng(3)
+    pops = [rng.integers(0, 500, size=400) for _ in range(3)]
+    hists = []
+    for pop in pops:
+        b = np.asarray(obs_histo.bucket_of(jnp.asarray(pop), bins))
+        hists.append(np.bincount(b, minlength=bins).astype(np.int64))
+    a, b_, c = hists
+    # merge IS elementwise add: exact, associative, commutative
+    assert ((a + b_) + c == a + (b_ + c)).all()
+    assert (a + b_ == b_ + a).all()
+    # merged quantile == quantile of the pooled population's histogram
+    pooled = np.asarray(obs_histo.bucket_of(
+        jnp.asarray(np.concatenate(pops)), bins))
+    pooled_hist = np.bincount(pooled, minlength=bins)
+    assert (a + b_ + c == pooled_hist).all()
+
+
+@pytest.mark.slow  # sharded compile cost exceeds the tier-1 budget
+def test_cluster_plane_bit_equal_to_shard_sum():
+    from deneva_tpu.parallel.sharded import ShardedEngine
+    cfg = Config(cc_alg="NO_WAIT", node_cnt=4, part_cnt=4, batch_size=32,
+                 synth_table_size=1 << 10, req_per_query=2,
+                 zipf_theta=0.5, query_pool_size=1 << 10, warmup_ticks=0,
+                 slo=True, arrival="poisson", arrival_rate=4.0)
+    eng = ShardedEngine(cfg)
+    st = eng.run(30)
+    s = eng.summary(st)
+    stacked = np.asarray(st.stats["arr_hist_fam"])
+    assert stacked.ndim == 3 and stacked.shape[0] == 4
+    cluster = np.asarray(eng.hist_cluster_plane(st))
+    # identity 2: device psum merge bit-equal to the host shard sum
+    assert np.array_equal(cluster, stacked.sum(axis=0))
+    # identity 1 holds on the psum'd cluster counters too
+    assert s["hist_total_cnt"] == s["txn_cnt"]
+    assert int(cluster.sum()) == s["txn_cnt"]
+
+
+# ---------------------------------------------------------------------------
+# the famlat survivor-ring tail bias (why the histograms exist)
+# ---------------------------------------------------------------------------
+
+def test_famlat_ring_tail_bias_vs_exact_histogram():
+    """Feed one family more commits than its survivor ring holds, with
+    the tail concentrated EARLY: the keep-last-S ring forgets the tail
+    and its p99 collapses, while the histogram (which binned every
+    commit) stays within its bucket-width error of the truth."""
+    S, bins, B = 64, 96, 50
+    cfg = Config(**BASE, slo=True, arrival="poisson", arrival_rate=8.0,
+                 fam_lat_samples=S)
+    stats = {
+        "arr_fam_lat": jnp.zeros((1, S), jnp.int32),
+        "arr_fam_cursor": jnp.zeros((1,), jnp.int32),
+        **obs_histo.init_histo(cfg, 1),
+    }
+    rng = np.random.default_rng(11)
+    # 400 commits: the first batches carry the 300-600-tick tail, the
+    # last ring-capacity's worth are all fast (4-10 ticks)
+    lats = np.concatenate([rng.integers(300, 600, size=100),
+                           rng.integers(4, 10, size=300)])
+    commit = jnp.ones((B,), bool)
+    fam = jnp.zeros((B,), jnp.int32)
+    for i in range(0, lats.size, B):
+        stats = traffic.record_family_latency(
+            stats, commit, fam, jnp.asarray(lats[i:i + B], jnp.int32),
+            jnp.asarray(True))
+    ring = traffic.family_percentiles(stats["arr_fam_lat"],
+                                      stats["arr_fam_cursor"])
+    hist = np.asarray(stats["arr_hist_fam"])[0]
+    assert int(hist.sum()) == lats.size          # every commit binned
+    true_p99 = float(np.percentile(lats, 99))
+    hist_p99 = obs_histo.quantile(hist, 0.99)
+    ring_p99 = ring["famlat0_p99"]
+    # the ring kept only the last S=64 fast commits: its p99 diverges
+    # by an order of magnitude; the histogram stays within bucket error
+    assert ring_p99 < 0.25 * true_p99, (ring_p99, true_p99)
+    assert abs(hist_p99 - true_p99) <= 0.15 * true_p99, (hist_p99,
+                                                         true_p99)
+
+
+# ---------------------------------------------------------------------------
+# the SLO engine: multi-window burn-rate alerting
+# ---------------------------------------------------------------------------
+
+def _hist_from(vals, bins):
+    b = np.asarray(obs_histo.bucket_of(jnp.asarray(vals), bins))
+    return np.bincount(b, minlength=bins).astype(np.int64)
+
+
+def test_burn_alert_fires_and_clears_on_synthetic_rate_step():
+    bins = 96
+    cfg = Config(**BASE, slo=True, arrival="poisson", arrival_rate=8.0,
+                 slo_p99_ceiling=64, slo_target=0.99, slo_burn_fast=5,
+                 slo_burn_slow=50, slo_burn_threshold=2.0)
+    tr = obs_slo.SloTracker(cfg)
+    rng = np.random.default_rng(5)
+    cum = np.zeros((1, bins), np.int64)
+    commits = 0
+
+    def step(tick, vals):
+        nonlocal cum, commits
+        cum = cum + _hist_from(vals, bins)[None]
+        commits += vals.size
+        return tr.observe(tick, cum,
+                          {"txn_cnt": commits, "arrival_cnt": commits,
+                           "queue_admit_cnt": commits,
+                           "total_txn_abort_cnt": 0})
+
+    # 10 healthy polls: every commit far under the ceiling -> burn 0
+    for i in range(10):
+        ev = step((i + 1) * 5, rng.integers(4, 20, size=40))
+        assert ev["burn_fast"] == 0.0 and not ev["fired"]
+    assert tr.alert_active is False
+    # the crowd: 30% of window commits breach -> burn 30x budget; the
+    # FAST window trips immediately, the alert waits for the SLOW one
+    fired_at = None
+    for i in range(10, 16):
+        vals = np.concatenate([rng.integers(4, 20, size=28),
+                               rng.integers(200, 400, size=12)])
+        ev = step((i + 1) * 5, vals)
+        assert ev["burn_fast"] > cfg.slo_burn_threshold
+        if ev["fired"]:
+            fired_at = (i + 1) * 5
+    assert fired_at is not None and tr.alert_active
+    assert (fired_at, "fire") in tr.events
+    # drain: healthy commits again -> fast window resets -> clear
+    cleared = False
+    for i in range(16, 20):
+        ev = step((i + 1) * 5, rng.integers(4, 20, size=40))
+        cleared = cleared or ev["cleared"]
+    assert cleared and not tr.alert_active
+    assert [e[1] for e in tr.events] == ["fire", "clear"]
+    f = tr.summary_fields()
+    assert f["slo_alert_cnt"] == 1 and f["slo_alert_active"] == 0
+    assert f["slo_breach_ticks"] > 0
+    assert f["burn_fast"] == 0.0
+
+
+def test_served_floor_and_abort_cap_breach_counters():
+    cfg = Config(**BASE, slo=True, arrival="poisson", arrival_rate=8.0,
+                 slo_served_floor=0.95, slo_abort_cap=0.5)
+    tr = obs_slo.SloTracker(cfg)
+    cum = np.zeros((1, 96), np.int64)
+    tr.observe(0, cum, {"txn_cnt": 0, "arrival_cnt": 0,
+                        "queue_admit_cnt": 0, "total_txn_abort_cnt": 0})
+    # window: 100 arrived, 50 admitted (served 0.5), 60 aborts vs 20
+    # commits (abort rate 0.75) -> both dashboards breach, no page
+    cum2 = cum + _hist_from(np.full(20, 5), 96)[None]
+    ev = tr.observe(5, cum2, {"txn_cnt": 20, "arrival_cnt": 100,
+                              "queue_admit_cnt": 50,
+                              "total_txn_abort_cnt": 60})
+    assert ev["served_frac"] == pytest.approx(0.5)
+    assert ev["abort_rate"] == pytest.approx(0.75)
+    assert tr.served_breach_cnt == 1 and tr.abort_breach_cnt == 1
+    assert not tr.alert_active
+
+
+# ---------------------------------------------------------------------------
+# exporter: OpenMetrics + JSONL round-trip
+# ---------------------------------------------------------------------------
+
+def test_exporter_openmetrics_and_jsonl_roundtrip(tmp_path):
+    cfg = Config(**BASE, slo=True, arrival="poisson", arrival_rate=8.0)
+    eng = Engine(cfg)
+    exporter = obs_telemetry.TelemetryExporter(cfg, str(tmp_path))
+    st = eng.run(20)
+    exporter.poll(st, 20)
+    st = eng.run(20, st)
+    rec = exporter.poll(st, 40)
+    s = eng.summary(st)
+
+    # JSONL: append-only, schema-tagged, quantiles == histogram quantiles
+    lines = [json.loads(ln) for ln in
+             (tmp_path / "telemetry.jsonl").read_text().splitlines()]
+    assert [r["poll"] for r in lines] == [0, 1]
+    assert all(r["schema"] == obs_telemetry.JSONL_SCHEMA for r in lines)
+    assert lines[-1] == rec
+    fam = np.asarray(st.stats["arr_hist_fam"])
+    assert rec["hist_total"] == int(fam.sum()) == s["txn_cnt"]
+    assert rec["fam"]["0"]["p99"] == obs_histo.quantile(fam[0], 0.99)
+    assert rec["fam"]["0"]["p99"] == s["slo_fam0_p99"]
+
+    # OpenMetrics: parses, EOF-terminated, cumulative and reconciled
+    parsed = obs_telemetry.parse_openmetrics(
+        (tmp_path / "metrics.om").read_text())
+    assert parsed["eof"]
+    assert parsed["types"][obs_telemetry.HIST_METRIC] == "histogram"
+    buckets = [(lab, v) for n, lab, v in parsed["samples"]
+               if n == f"{obs_telemetry.HIST_METRIC}_bucket"
+               and lab.get("family") == "0"]
+    cum = [v for _, v in buckets]
+    assert cum == sorted(cum), "bucket samples must be cumulative"
+    assert buckets[-1][0]["le"] == "+Inf"
+    count = obs_telemetry.sample_value(
+        parsed, f"{obs_telemetry.HIST_METRIC}_count", family=0)
+    assert count == buckets[-1][1] == rec["hist_total"]
+    assert obs_telemetry.sample_value(
+        parsed, f"{obs_telemetry.COMMITS_METRIC}_total") == s["txn_cnt"]
+    for w in ("fast", "slow"):
+        assert obs_telemetry.sample_value(
+            parsed, obs_telemetry.BURN_METRIC, window=w) is not None
+
+
+# ---------------------------------------------------------------------------
+# summary-line passthrough + report + trace track
+# ---------------------------------------------------------------------------
+
+def test_reference_line_and_watchdog_bit():
+    cfg = Config(**BASE, slo=True, arrival="poisson", arrival_rate=8.0)
+    eng = Engine(cfg)
+    st = eng.run(30)
+    s = eng.summary(st)
+    s.update({"slo_alert_active": 1, "slo_alert_cnt": 2,
+              "slo_breach_ticks": 40, "slo_served_breach_cnt": 0,
+              "slo_abort_breach_cnt": 0, "burn_fast": 5.0,
+              "burn_slow": 3.0, "burn_served_frac": 0.8,
+              "burn_abort_rate": 0.3})
+    findings, code = obs_report.watchdog(s)
+    assert code & obs_report.SLO
+    assert any(f[0] == "SLO" for f in findings)
+    rep = obs_report.build_report(s)
+    assert rep["slo"]["families"][0]["p99"] == s["slo_fam0_p99"]
+    assert rep["slo"]["alert_active"] == 1
+    txt = obs_report.render_text(rep)
+    assert "[slo]" in txt and "FIRING" in txt
+    # cleared alert: no bit
+    s["slo_alert_active"] = 0
+    _, code2 = obs_report.watchdog(s)
+    assert not code2 & obs_report.SLO
+    # broken identity: bit fires through RECONCILE
+    s2 = dict(s)
+    s2["hist_total_cnt"] = s2["txn_cnt"] + 1
+    _, code3 = obs_report.watchdog(s2)
+    assert code3 & obs_report.RECONCILE and code3 & obs_report.SLO
+
+
+def test_slo_trace_series_and_chrome_track(tmp_path):
+    from deneva_tpu.obs import export as obs_export
+    cfg = Config(**BASE, slo=True, trace_ticks=64,
+                 arrival="poisson", arrival_rate=8.0)
+    eng = Engine(cfg)
+    st = eng.run(40)
+    tl = obs_trace.timeline(st)
+    assert "slo_f0_p99" in tl and "slo_f0_burn" in tl
+    # the p99 gauge series is cumulative-monotone (ring accumulates a
+    # nondecreasing cumulative-histogram quantile)
+    p99 = tl["slo_f0_p99"]
+    assert max(p99) > 0
+    p = tmp_path / "tr.json"
+    obs_trace.to_chrome_trace(st, str(p), n_ticks=40)
+    doc = json.loads(p.read_text())
+    assert doc["metadata"].get("slo_track") == ["slo_f0_p99",
+                                                "slo_f0_burn"]
+    assert any(ev.get("name") == "slo burn rate"
+               for ev in doc["traceEvents"])
+    # the export merger rebuilds the same track from a run record
+    # (records are JSON, so the timeline arrives as plain lists)
+    events = obs_export.record_events(
+        {"timeline": {k: np.asarray(v).tolist() for k, v in tl.items()}})
+    assert any(ev.get("name") == "slo burn rate" for ev in events)
+    # off path: no series, no track, no metadata flag
+    cfg0 = Config(**BASE, trace_ticks=64)
+    eng0 = Engine(cfg0)
+    st0 = eng0.run(10)
+    assert not any(k.startswith("slo_f") for k in obs_trace.timeline(st0))
+    p0 = tmp_path / "tr0.json"
+    obs_trace.to_chrome_trace(st0, str(p0))
+    assert "slo_track" not in json.loads(p0.read_text())["metadata"]
+
+
+def test_regress_slo_ceiling_self_arms_then_gates():
+    from deneva_tpu.obs import regress
+    doc1 = {"metric": "serve_slo", "value": 40.0,
+            "slo_p99": {"fam0": 40.0}}
+    doc2 = {"metric": "serve_slo", "value": 90.0,
+            "slo_p99": {"fam0": 90.0}}
+    e1 = regress._entry("h", (1, 1.0), doc1)
+    e2 = regress._entry("h", (1, 2.0), doc2)
+    # first point: no prior -> the ceiling self-arms, nothing fails
+    r1 = regress.gate([e1])
+    assert not r1["failures"]
+    assert any("slo_p99[fam0]" in s for s in r1["skipped"])
+    # second point: p99 more than (1 + tol) x median -> regression
+    r2 = regress.gate([e1, e2])
+    assert any("slo_p99[fam0]" in f for f in r2["failures"])
+
+
+# ---------------------------------------------------------------------------
+# serve mode: the zero-retrace contract
+# ---------------------------------------------------------------------------
+
+def test_serve_polls_never_retrace_single_engine():
+    cfg = Config(**BASE, slo=True, xmeter=True, arrival="step",
+                 arrival_schedule=((0, 2.0), (20, 30.0), (40, 2.0)))
+    eng = Engine(cfg)
+    exporter = obs_telemetry.TelemetryExporter(
+        cfg, str("/tmp/_telemetry_retrace_test"))
+    st = eng.run(10)
+    eng.xmeter.mark_warm()
+    tick = 10
+    for _ in range(5):                  # polls interleaved with running,
+        st = eng.run(10, st)            # across BOTH rate steps
+        tick += 10
+        exporter.poll(st, tick)
+    assert eng.xmeter.steady_violations() == []
+    assert exporter.polls == 5
+
+
+@pytest.mark.slow  # sharded compile cost exceeds the tier-1 budget
+def test_serve_sharded_zero_recompiles_and_parity(tmp_path):
+    from deneva_tpu.parallel.sharded import ShardedEngine
+    cfg = Config(cc_alg="NO_WAIT", node_cnt=2, part_cnt=2, batch_size=32,
+                 synth_table_size=1 << 10, req_per_query=2,
+                 zipf_theta=0.5, query_pool_size=1 << 10, warmup_ticks=0,
+                 slo=True, xmeter=True, arrival="step",
+                 arrival_schedule=((0, 2.0), (20, 16.0), (40, 2.0)))
+    eng = ShardedEngine(cfg)
+    exporter = obs_telemetry.TelemetryExporter(cfg, str(tmp_path))
+    st = eng.run(10)
+    eng.xmeter.mark_warm()
+    tick = 10
+    for _ in range(5):
+        st = eng.run(10, st)
+        tick += 10
+        rec = exporter.poll(st, tick)
+    assert eng.xmeter.steady_violations() == []
+    s = eng.summary(st)
+    # the exporter collapsed the node-stacked plane exactly
+    assert rec["hist_total"] == s["hist_total_cnt"] == s["txn_cnt"]
+    assert np.array_equal(np.asarray(eng.hist_cluster_plane(st)),
+                          np.asarray(st.stats["arr_hist_fam"]).sum(axis=0))
