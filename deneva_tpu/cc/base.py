@@ -92,6 +92,68 @@ TICK_CERTIFY: dict = {
 }
 
 
+class CommSpec(NamedTuple):
+    """One declared cross-node collective of the distributed data plane,
+    consumed by the sharded collective certifier (lint/shard_certify.py,
+    LINT.md engine 4).
+
+    The certifier lowers the sharded tick through the real SPMD
+    partitioner and matches every collective op of the post-partitioning
+    StableHLO against these records.  A spec is keyed by
+    ``(op, site)``: ``op`` is the StableHLO collective kind
+    (``all_to_all`` / ``all_reduce`` / ``all_gather`` /
+    ``collective_permute``) and ``site`` is ``(path suffix, function
+    names)`` — a collective matches when its callsite chain contains a
+    frame inside ``site[0]`` whose function name is in ``site[1]``.
+    Matching by function (not line) survives line drift; op kind
+    disambiguates multiple collectives inside one closure.
+
+    ``role`` classifies the operands by provenance and fixes the legal
+    reduction set (COMM_ROLES): ``data`` moves data-plane entry tensors
+    (value movement only — an all-reduce over a data role is never
+    declarable), ``counter`` crosses commutative int32 counter planes
+    (add only), ``clock`` takes a global extremum of a monotone scalar
+    (max), ``log`` ships replication log records point-to-point.
+    ``when`` records the static config predicate that compiles the
+    collective in.
+    """
+
+    name: str                       # stable id, e.g. "exchange.ship"
+    op: str                         # StableHLO collective kind
+    site: tuple                     # (path suffix, (func, ...))
+    role: str                       # COMM_ROLES key
+    when: str                       # static gate, for docs/findings
+    note: str = ""
+
+
+#: The communication-plane contract policy, the engine-4 companion to
+#: TICK_CERTIFY: the axis every collective must span, the legal
+#: reduction combiners per operand role, and the functions whose values
+#: the design asserts REPLICATED across nodes (round plans and config
+#: scalars are computed identically on every shard — the SPMD
+#: partitioner deciding one needs a cross-partition reduction is exactly
+#: the PR 12 corruption class, rule REPLICATION-DRIFT).  The site list
+#: itself lives next to the code that issues the collectives:
+#: parallel/routing.py ROUTING_COMM and parallel/sharded.py SHARDED_COMM
+#: (cc must not import parallel — parallel imports cc; sharded.py
+#: asserts its axis name equals COMM_CONTRACT["axis"] at import).
+COMM_CONTRACT: dict = {
+    "axis": "node",
+    "collectives": ("all_reduce", "all_gather", "all_to_all",
+                    "collective_permute"),
+    "replicated": (("parallel/routing.py", "round_plan"),),
+}
+
+#: operand role -> all-reduce combiners the role may legally cross with
+#: (empty: the role must never be reduced across the mesh at all)
+COMM_ROLES: dict = {
+    "data": (),
+    "counter": ("add",),
+    "clock": ("max",),
+    "log": (),
+}
+
+
 # --- abort-reason taxonomy (the observatory's machine-readable registry) ---
 #: Every abort event the engine records is tagged with exactly one of
 #: these reasons; the per-reason counters partition the aggregates so

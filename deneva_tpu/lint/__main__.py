@@ -1,7 +1,7 @@
 import sys
 
-if "--certify" in sys.argv:
-    # the certifier's sharded cells need >= 4 virtual devices; the env
+if "--certify" in sys.argv or "--certify-sharded" in sys.argv:
+    # the certifiers' sharded cells need >= 4 virtual devices; the env
     # must be set before the FIRST jax import (neither deneva_tpu nor
     # deneva_tpu.lint import jax at module scope, so this is it)
     from deneva_tpu.lint.certify import _device_env

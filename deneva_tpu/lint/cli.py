@@ -112,12 +112,31 @@ def main(argv: list[str] | None = None) -> int:
                          "certifier over the full config matrix "
                          "(see python -m deneva_tpu.lint.certify for "
                          "cell filters)")
+    ap.add_argument("--certify-sharded", action="store_true",
+                    help="run engine 4 only: the sharded collective "
+                         "certifier — lower every plugin x workload x "
+                         "distributed-flag cell through the SPMD "
+                         "partitioner and prove the StableHLO "
+                         "collectives against COMM_CONTRACT (see "
+                         "python -m deneva_tpu.lint.shard_certify for "
+                         "cell filters)")
     args = ap.parse_args(argv)
 
     if args.certify:
         from deneva_tpu.lint import certify
         findings = certify.run_certify(
             log=lambda m: print(f"[certify] {m}", file=sys.stderr))
+        if args.format == "json":
+            print(render_json(findings))
+        else:
+            print(render_text(findings, args.show_suppressed))
+        return min(sum(not f.suppressed for f in findings), 125)
+
+    if args.certify_sharded:
+        from deneva_tpu.lint import shard_certify
+        findings = shard_certify.run_shard_certify(
+            log=lambda m: print(f"[certify-sharded] {m}",
+                                file=sys.stderr))
         if args.format == "json":
             print(render_json(findings))
         else:

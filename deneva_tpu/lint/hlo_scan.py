@@ -1,0 +1,267 @@
+"""StableHLO collective extraction for the sharded certifier.
+
+The sharded tick certifier (lint/shard_certify.py, engine 4) works on
+the *post-partitioning* program: ``jax.jit(tick).lower(state)`` runs the
+SPMD partitioner, and the resulting StableHLO module is where
+partitioner-INSERTED collectives become visible — the PR 12 bug class
+(an unplanned cross-partition ``all-reduce`` materializing inside a
+shard-local computation) does not exist in the pre-partitioning jaxpr
+that engine 3 certifies.
+
+This module is the extraction half: walk an MLIR module recursively and
+return one :class:`Collective` record per collective operation, carrying
+
+- the op kind (``all_reduce`` / ``collective_permute`` / ``all_gather``
+  / ``all_to_all`` / ``reduce_scatter`` / ``collective_broadcast``),
+- the reduction combiner for ops with a combinator region (``add``,
+  ``max``, ...),
+- the device grouping (``replica_groups`` rows, or
+  ``source_target_pairs`` for permutes),
+- whether the op sits inside a ``stablehlo.while`` body (a ``lax.scan``
+  / ``lax.while_loop`` lowers to one — the EXCHANGE-DYNAMIC-ROUND
+  hazard), plus the loop's own source anchor,
+- the repo-internal callsite chain parsed from the op's MLIR location
+  (innermost first), which is how findings anchor to real source lines
+  and how COMM_CONTRACT sites are matched.
+
+The walk is read-only and engine-agnostic: it never imports the engine,
+the contract, or jax itself — it only needs the ``ir.Module`` duck type
+(``body.operations`` / ``operation.regions`` / ``location``), so the
+unit tests can also feed it hand-built stand-ins.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+
+#: stablehlo collective op names -> short kind used by COMM_CONTRACT
+COLLECTIVE_OPS = {
+    "stablehlo.all_reduce": "all_reduce",
+    "stablehlo.all_gather": "all_gather",
+    "stablehlo.all_to_all": "all_to_all",
+    "stablehlo.collective_permute": "collective_permute",
+    "stablehlo.reduce_scatter": "reduce_scatter",
+    "stablehlo.collective_broadcast": "collective_broadcast",
+}
+
+#: combinator-region op name -> canonical combiner label
+_COMBINERS = {
+    "stablehlo.add": "add",
+    "stablehlo.maximum": "max",
+    "stablehlo.minimum": "min",
+    "stablehlo.multiply": "mul",
+    "stablehlo.and": "and",
+    "stablehlo.or": "or",
+    "stablehlo.xor": "xor",
+}
+
+#: ops whose region (if any) is a loop body, not a combinator
+_LOOP_OPS = ("stablehlo.while",)
+
+#: one named frame of an MLIR callsite chain: "func"("file":line:col)
+_FRAME_RE = re.compile(r'"([^"]+)"\("([^"]+)":(\d+):(\d+)\)')
+
+
+@dataclasses.dataclass(frozen=True)
+class Frame:
+    """One repo-internal callsite frame (innermost first in the chain)."""
+    path: str      # absolute source path
+    line: int      # 1-based
+    func: str      # enclosing function name ("<dictcomp>" et al. kept)
+
+
+@dataclasses.dataclass(frozen=True)
+class Collective:
+    op: str                       # short kind, COLLECTIVE_OPS values
+    combiner: str | None          # all_reduce/reduce_scatter region op
+    replica_groups: tuple[tuple[int, ...], ...] | None
+    source_target_pairs: tuple[tuple[int, int], ...] | None
+    frames: tuple[Frame, ...]     # repo-internal chain, innermost first
+    in_loop: bool = False         # inside a stablehlo.while body
+    loop_frames: tuple[Frame, ...] = ()   # anchor of the enclosing loop
+
+    def anchor(self) -> tuple[str, int]:
+        """(path, line) for the finding: the innermost repo frame of the
+        op itself, falling back to the enclosing loop's anchor (a
+        partitioner-inserted op inside a loop body may carry no user
+        location of its own)."""
+        for fr in self.frames + self.loop_frames:
+            return fr.path, fr.line
+        return "<stablehlo>", 0
+
+    def funcs(self) -> tuple[str, ...]:
+        return tuple(fr.func for fr in self.frames)
+
+
+def parse_frames(loc_str: str, repo_root: str) -> tuple[Frame, ...]:
+    """Repo-internal frames of an MLIR location string, innermost first.
+
+    JAX emits nested ``callsite`` locations of the form
+    ``loc("jit(f)/.../all_to_all"(callsite("g"("/abs/file.py":12:0) at
+    callsite(...))))``; the named-frame regex scans them in textual
+    order, which IS innermost-first.  Frames outside ``repo_root``
+    (jax/jaxlib internals) are dropped.
+    """
+    out = []
+    for m in _FRAME_RE.finditer(loc_str):
+        func, path, line = m.group(1), m.group(2), int(m.group(3))
+        if path.startswith(repo_root):
+            out.append(Frame(path=path, line=line, func=func))
+    return tuple(out)
+
+
+def _dense_rows(attr_str: str) -> tuple[tuple[int, ...], ...] | None:
+    """Rows of a DenseIntElements attribute from its string form, e.g.
+    ``dense<[[0, 1, 2, 3]]> : tensor<1x4xi64>`` -> ((0, 1, 2, 3),).
+    A splat (``dense<0>``) or an empty tensor yields ()."""
+    m = re.search(r"dense<(.*)>\s*:\s*tensor<([^>]*)>", attr_str,
+                  re.DOTALL)
+    if not m:
+        return None
+    body, shape = m.group(1).strip(), m.group(2)
+    rows = tuple(tuple(int(x) for x in re.findall(r"-?\d+", row))
+                 for row in re.findall(r"\[([^\[\]]*)\]", body))
+    if rows:
+        return rows
+    if re.fullmatch(r"-?\d+", body):
+        # splat: expand against the declared tensor shape's row count
+        dims = [int(d) for d in re.findall(r"\d+", shape)]
+        n_rows = dims[0] if dims else 1
+        width = dims[1] if len(dims) > 1 else 1
+        return tuple((int(body),) * width for _ in range(n_rows))
+    return ()
+
+
+def _attr_rows(op, name: str) -> tuple[tuple[int, ...], ...] | None:
+    try:
+        attr = op.attributes[name]
+    except (KeyError, IndexError):
+        return None
+    return _dense_rows(str(attr))
+
+
+def _region_combiner(op) -> str | None:
+    """The single reduction op of a combinator region (all_reduce and
+    friends); None when the region holds anything but one known
+    combiner + return — callers treat that as 'unknown', which never
+    silently passes a commutativity check."""
+    found = []
+    for region in op.regions:
+        for block in region.blocks:
+            for inner in block.operations:
+                name = inner.operation.name
+                if name == "stablehlo.return":
+                    continue
+                found.append(_COMBINERS.get(name))
+    if len(found) == 1:
+        return found[0]
+    return None
+
+
+def _sym_name(generic) -> str:
+    try:
+        return str(generic.attributes["sym_name"]).strip('"')
+    except (KeyError, IndexError):
+        return "<anonymous>"
+
+
+def _callee(generic) -> str | None:
+    try:
+        return str(generic.attributes["callee"]).lstrip("@").strip('"')
+    except (KeyError, IndexError):
+        return None
+
+
+def scan_module(module, repo_root: str) -> list[Collective]:
+    """All collective ops of a lowered StableHLO module, each with
+    loop-nesting state and repo-anchored frames.
+
+    Loop membership is computed across the CALL GRAPH, not just
+    lexically: JAX outlines ``lax.scan``/``while_loop`` bodies into
+    private ``func.func``s reached by a ``func.call`` inside the
+    ``stablehlo.while`` region, so a loop-carried collective usually
+    lives in a different function than the loop.  The walk records each
+    function's collectives and call edges (with the caller's loop
+    state), then propagates loop taint to a fixed point; a tainted
+    collective inherits the tainting call edge's loop anchor.  A
+    function reached from BOTH loop and non-loop contexts counts as
+    looped — conservative in the certifier's favor.
+    """
+    colls: dict[str, list[Collective]] = {}
+    calls: dict[str, list[tuple[str, bool, tuple[Frame, ...]]]] = {}
+
+    def visit(op, fn: str, in_loop: bool, loop_frames: tuple[Frame, ...]):
+        generic = op.operation
+        name = generic.name
+        kind = COLLECTIVE_OPS.get(name)
+        if kind is not None:
+            frames = parse_frames(str(op.location), repo_root)
+            colls[fn].append(Collective(
+                op=kind,
+                combiner=_region_combiner(generic)
+                if kind in ("all_reduce", "reduce_scatter") else None,
+                replica_groups=_attr_rows(generic, "replica_groups"),
+                source_target_pairs=_attr_rows(
+                    generic, "source_target_pairs"),
+                frames=frames,
+                in_loop=in_loop,
+                loop_frames=loop_frames,
+            ))
+            # a combinator region holds no user collectives; don't
+            # recurse into it (its add/max would re-anchor nowhere)
+            return
+        callee = _callee(generic) if name in ("func.call", "call") \
+            else None
+        if callee is not None:
+            calls[fn].append((callee, in_loop, loop_frames))
+        nested_loop = in_loop or name in _LOOP_OPS
+        nested_frames = loop_frames
+        if name in _LOOP_OPS and not in_loop:
+            nested_frames = parse_frames(str(op.location), repo_root)
+        for region in generic.regions:
+            for block in region.blocks:
+                for inner in block.operations:
+                    visit(inner, fn, nested_loop, nested_frames)
+
+    for op in module.body.operations:
+        generic = op.operation
+        fn = _sym_name(generic) if generic.name == "func.func" \
+            else "<toplevel>"
+        colls.setdefault(fn, [])
+        calls.setdefault(fn, [])
+        if generic.name == "func.func":
+            for region in generic.regions:
+                for block in region.blocks:
+                    for inner in block.operations:
+                        visit(inner, fn, False, ())
+        else:
+            visit(op, fn, False, ())
+
+    # propagate loop taint through call edges to a fixed point
+    taint: dict[str, tuple[Frame, ...]] = {}
+    changed = True
+    while changed:
+        changed = False
+        for fn, edges in calls.items():
+            caller_taint = taint.get(fn)
+            for callee, edge_in_loop, edge_frames in edges:
+                if callee in taint or callee not in colls:
+                    continue
+                if edge_in_loop:
+                    taint[callee] = edge_frames
+                    changed = True
+                elif caller_taint is not None:
+                    taint[callee] = caller_taint
+                    changed = True
+
+    found: list[Collective] = []
+    for fn, items in colls.items():
+        fn_taint = taint.get(fn)
+        for c in items:
+            if fn_taint is not None and not c.in_loop:
+                c = dataclasses.replace(
+                    c, in_loop=True,
+                    loop_frames=c.loop_frames or fn_taint)
+            found.append(c)
+    return found

@@ -245,6 +245,88 @@ _ALL = [
             "suppress it with the overflow argument spelled out.",
     ),
     Rule(
+        id="COLLECTIVE-UNDECLARED",
+        title="Collective op not declared in COMM_CONTRACT",
+        rationale="The post-partitioning StableHLO contains a collective "
+                  "(all-reduce / permute / gather / all-to-all) matching "
+                  "no CommSpec site — either new cross-node traffic "
+                  "nobody declared, or the SPMD partitioner INSERTED a "
+                  "cross-partition reduction into a computation the "
+                  "design holds shard-local: the PR 12 bug class, which "
+                  "silently corrupts the data plane (an unplanned sum "
+                  "over per-shard round-plan sort keys).",
+        fix="If the traffic is intended, declare a CommSpec for the site "
+            "(parallel/routing.py ROUTING_COMM / parallel/sharded.py "
+            "SHARDED_COMM) with its role and gate; if not, restructure "
+            "so the partitioner keeps the value shard-local (trace-time "
+            "unrolled sub-rounds, explicit shard_map body, replicated "
+            "operands).",
+    ),
+    Rule(
+        id="COUNTER-NONCOMMUTATIVE",
+        title="Cross-mesh reduction illegal for the operand role",
+        rationale="COMM_CONTRACT classifies collective operands by "
+                  "provenance: int32 counter planes may only cross the "
+                  "mesh via add-reductions (exact, order-free integer "
+                  "sums — the bit-exact cluster summary guarantee); "
+                  "clock scalars only via max; data/log tensors never "
+                  "via a reduction at all.  Any other combiner makes the "
+                  "result depend on partition order or collapses "
+                  "distinct per-node values.",
+        fix="Use the role's legal combiner (psum for counters, pmax for "
+            "clocks), or reclassify the CommSpec role if the operand "
+            "provenance was declared wrong.",
+    ),
+    Rule(
+        id="AXIS-UNDECLARED",
+        title="Collective does not span the declared node axis",
+        rationale="Every cross-node collective must run over the one "
+                  "registered mesh axis (COMM_CONTRACT['axis']): its "
+                  "replica groups must cover the full node extent in a "
+                  "single group, and permute pairs must stay inside it. "
+                  "A sub-axis group means the partitioner split traffic "
+                  "over an undeclared dimension — summaries and "
+                  "exchanges then cover only part of the cluster.",
+        fix="Issue the collective over the registered axis name (the "
+            "shard_map axis), not a sub-mesh; if a new axis is real "
+            "(e.g. a future 2-D mesh), register it in COMM_CONTRACT "
+            "first.",
+    ),
+    Rule(
+        id="EXCHANGE-DYNAMIC-ROUND",
+        title="Collective carried through an XLA while/scan loop",
+        rationale="A collective inside a lowered `while` body (what "
+                  "lax.scan/while_loop become) runs a data-dependent "
+                  "number of times AND hands the SPMD partitioner a "
+                  "loop-carried sharding it must re-solve per iteration "
+                  "— the exact PR 12 failure: scan-lowered exchange "
+                  "sub-rounds made the partitioner insert cross-"
+                  "partition sums into the shard-local round-plan sort. "
+                  "Exchange sub-rounds must be trace-time-unrolled "
+                  "Python loops with a static trip count.",
+        fix="Unroll the sub-round loop at trace time (Python for over "
+            "range(n_rounds), as parallel/sharded.py does for the "
+            "split exchange); keep collectives out of lax.scan/"
+            "while_loop bodies.",
+    ),
+    Rule(
+        id="REPLICATION-DRIFT",
+        title="Contract-replicated value sharded then re-reduced",
+        rationale="COMM_CONTRACT['replicated'] names computations whose "
+                  "values are node-invariant by construction (round "
+                  "plans, config scalars): every shard computes them "
+                  "identically, so NO collective may originate inside "
+                  "them.  One appearing there means the partitioner "
+                  "decided the value is sharded and must be re-reduced "
+                  "— replicas have drifted, and the reduction changes "
+                  "the value on every node.",
+        fix="Keep the computation's operands replicated (derive them "
+            "from shard-local entries identically on every node, or "
+            "broadcast once outside the loop); a genuinely sharded "
+            "value must leave the replicated list and gain its own "
+            "declared CommSpec.",
+    ),
+    Rule(
         id="CONTRACT-CONST",
         title="Large concrete array baked into a hook closure",
         rationale="A hook closing over a big device array turns it into "

@@ -1,0 +1,353 @@
+"""Lint engine 4 — the sharded collective certifier.
+
+Engine 3 (lint/certify.py) certifies the *pre-partitioning* jaxpr of
+the sharded tick.  That program never shows what the SPMD partitioner
+does with it: which collectives actually cross the mesh, which ones the
+partitioner *inserted* on its own, and what each reduces with.  PR 12
+demonstrated the gap — lowering the exchange sub-round loop to an XLA
+``while`` made the partitioner silently weave cross-partition sums into
+the shard-local round-plan sort, corrupting the data plane while every
+jaxpr-level check stayed green.
+
+This engine closes it: for every CC plugin × workload × distributed
+opt-in flag it pushes ``parallel/sharded.py:sharded_tick_for_trace``
+through the REAL partitioner (``jax.jit(...).lower()`` at the
+cc/base.py TICK_CERTIFY mesh geometry, N virtual devices), walks the
+post-partitioning StableHLO for every collective op
+(lint/hlo_scan.py), and proves each against the machine-readable
+communication contract:
+
+- policy half: cc/base.py ``COMM_CONTRACT`` (the registered node axis,
+  the replicated-value list) and ``COMM_ROLES`` (operand role → legal
+  reduction combiners);
+- site half: ``parallel/routing.py ROUTING_COMM`` +
+  ``parallel/sharded.py SHARDED_COMM`` (one CommSpec per collective the
+  data plane may lower to, keyed by op kind + callsite function).
+
+The cluster-counter aggregator (a separate jitted shard_map,
+``sharded_counter_agg_for_trace``) is lowered too: its psums are the
+positive proof of the role=counter policy — int32 counter planes cross
+the mesh as exact integer add-reductions, nothing else.
+
+Rules (lint/rules.py, same Finding / suppression / exit-code framework
+as engines 1-3): COLLECTIVE-UNDECLARED, COUNTER-NONCOMMUTATIVE,
+AXIS-UNDECLARED, EXCHANGE-DYNAMIC-ROUND, REPLICATION-DRIFT.
+
+Run: ``python -m deneva_tpu.lint --certify-sharded`` (or this module
+directly, with cell filters).  Exit code = unsuppressed findings.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from deneva_tpu.lint import hlo_scan
+from deneva_tpu.lint.certify import (_certify_spec, _dedup_and_suppress,
+                                     _device_env, base_cfg)
+from deneva_tpu.lint.rules import Finding
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+#: flag sweeps run on every YCSB cell; TPC-C/PPS cells sweep only the
+#: distributed-semantics core (the flags that add or reshape cross-node
+#: traffic) on the engine-3 representative plugins — observability
+#: flags are workload-independent and YCSB already proves them
+_CORE_DISTRIBUTED_FLAGS = ("exchange_split", "remote_cache", "repl_cnt",
+                           "mesh", "faults", "adaptive", "slo",
+                           "net_delay_ticks")
+_SWEEP_ALGS_NON_YCSB = ("NO_WAIT", "MAAT")
+
+
+def load_comm_contract() -> dict:
+    """Compose the two contract halves (cc policy + parallel sites)."""
+    from deneva_tpu.cc.base import COMM_CONTRACT, COMM_ROLES
+    from deneva_tpu.parallel.sharded import SHARDED_COMM
+    return {**COMM_CONTRACT, "roles": COMM_ROLES, "specs": SHARDED_COMM}
+
+
+# ---------------------------------------------------------------------------
+# the pure checker — fixture tests inject synthetic contracts here
+
+
+def _match_spec(coll: hlo_scan.Collective, specs):
+    for spec in specs:
+        if spec.op != coll.op:
+            continue
+        path_sfx, funcs = spec.site
+        for fr in coll.frames:
+            if fr.path.endswith(path_sfx) and fr.func in funcs:
+                return spec
+    return None
+
+
+def _replicated_hit(coll: hlo_scan.Collective, contract) -> str | None:
+    for path_sfx, func in contract.get("replicated", ()):
+        for fr in coll.frames:
+            if fr.path.endswith(path_sfx) and fr.func == func:
+                return f"{path_sfx}:{func}"
+    return None
+
+
+def _axis_ok(coll: hlo_scan.Collective, node_cnt: int) -> bool:
+    if coll.op == "collective_permute":
+        pairs = coll.source_target_pairs or ()
+        if not pairs:
+            return False
+        srcs = [s for s, _ in pairs]
+        tgts = [t for _, t in pairs]
+        return (all(0 <= s < node_cnt and 0 <= t < node_cnt
+                    for s, t in pairs)
+                and len(set(srcs)) == len(srcs)
+                and len(set(tgts)) == len(tgts))
+    groups = coll.replica_groups or ()
+    return (len(groups) == 1
+            and tuple(sorted(groups[0])) == tuple(range(node_cnt)))
+
+
+def check_collectives(collectives, contract, *, node_cnt: int,
+                      cell: str) -> list[Finding]:
+    """Prove one lowered module's collectives against the contract.
+
+    Pure: no lowering, no imports of the engine — tests feed synthetic
+    Collective lists and fixture contracts.  Per collective, in order:
+
+    1. inside an XLA ``while`` body    -> EXCHANGE-DYNAMIC-ROUND
+       (anchored at the loop site; a loop-carried collective is illegal
+       no matter what it is, so no further checks run on it)
+    2. callsite chain crosses a contract-replicated computation
+                                       -> REPLICATION-DRIFT
+    3. no CommSpec matches (op, site)  -> COLLECTIVE-UNDECLARED
+    4. device grouping does not span the registered axis
+                                       -> AXIS-UNDECLARED
+    5. reduction combiner outside the spec role's legal set
+                                       -> COUNTER-NONCOMMUTATIVE
+    """
+    findings: list[Finding] = []
+    for c in collectives:
+        path, line = c.anchor()
+        label = c.op + (f"({c.combiner})" if c.combiner else "")
+        if c.in_loop:
+            if c.loop_frames:
+                path, line = c.loop_frames[0].path, c.loop_frames[0].line
+            findings.append(Finding(
+                rule="EXCHANGE-DYNAMIC-ROUND", path=path, line=line,
+                message=f"[{cell}] {label} carried through an XLA while "
+                        f"loop (a lowered lax.scan/while_loop body) — "
+                        f"sub-round exchanges must be trace-time "
+                        f"unrolled with a static trip count"))
+            continue
+        hit = _replicated_hit(c, contract)
+        if hit is not None:
+            findings.append(Finding(
+                rule="REPLICATION-DRIFT", path=path, line=line,
+                message=f"[{cell}] {label} originates inside {hit}, "
+                        f"which COMM_CONTRACT asserts replicated — the "
+                        f"partitioner decided the value is sharded and "
+                        f"re-reduced it"))
+            continue
+        spec = _match_spec(c, contract["specs"])
+        if spec is None:
+            declared = ", ".join(s.name for s in contract["specs"])
+            findings.append(Finding(
+                rule="COLLECTIVE-UNDECLARED", path=path, line=line,
+                message=f"[{cell}] {label} at {c.funcs()[:2]} matches "
+                        f"no CommSpec (declared: {declared}) — "
+                        f"undeclared cross-node traffic or a "
+                        f"partitioner-inserted reduction"))
+            continue
+        if not _axis_ok(c, node_cnt):
+            grouping = (c.source_target_pairs
+                        if c.op == "collective_permute"
+                        else c.replica_groups)
+            findings.append(Finding(
+                rule="AXIS-UNDECLARED", path=path, line=line,
+                message=f"[{cell}] {label} ({spec.name}) grouping "
+                        f"{grouping} does not span the declared "
+                        f"'{contract['axis']}' axis of {node_cnt} "
+                        f"nodes"))
+            continue
+        if c.op in ("all_reduce", "reduce_scatter"):
+            allowed = contract["roles"].get(spec.role, ())
+            if c.combiner not in allowed:
+                legal = ", ".join(allowed) or "none (value movement only)"
+                findings.append(Finding(
+                    rule="COUNTER-NONCOMMUTATIVE", path=path, line=line,
+                    message=f"[{cell}] {label} reduces a role="
+                            f"{spec.role} operand ({spec.name}); legal "
+                            f"combiners for the role: {legal}"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# cell lowering
+
+
+def lower_collectives(fn, arg, donate: bool = True
+                      ) -> list[hlo_scan.Collective]:
+    """Lower one callable through the real SPMD partitioner and extract
+    its collectives."""
+    import jax
+    jitted = jax.jit(fn, donate_argnums=0) if donate else jax.jit(fn)
+    mod = jitted.lower(arg).compiler_ir(dialect="stablehlo")
+    return hlo_scan.scan_module(mod, _REPO_ROOT)
+
+
+def cell_cfg(alg: str, workload: str):
+    """Baseline sharded Config for one matrix cell.  TPC-C's toy
+    downsizing pins num_wh=2 (engine 3 traces it single-node only);
+    the sharded mesh needs one warehouse multiple per node."""
+    cfg = base_cfg(alg, workload, "sharded_tick")
+    if workload == "TPCC":
+        cfg = cfg.replace(num_wh=cfg.node_cnt)
+    return cfg
+
+
+def certify_cell(cfg, cell: str, contract, log=None) -> list[Finding]:
+    from deneva_tpu.parallel.sharded import sharded_tick_for_trace
+    fn, state = sharded_tick_for_trace(cfg)
+    colls = lower_collectives(fn, state)
+    if not colls:
+        # every sharded tick carries at least the exchange all_to_alls
+        # and the ts-rebase extremum; an empty scan means the walker
+        # (not the program) broke — fail loud, never certify vacuously
+        raise RuntimeError(f"{cell}: no collectives found in the "
+                           f"lowered tick — hlo_scan is broken")
+    findings = check_collectives(colls, contract,
+                                 node_cnt=cfg.node_cnt, cell=cell)
+    if log:
+        log(f"{cell}: {len(colls)} collectives, "
+            f"{len(findings)} finding(s)")
+    return findings
+
+
+def certify_agg_cell(alg: str, contract, log=None) -> list[Finding]:
+    """The cluster-counter aggregator: role=counter positive proof."""
+    from deneva_tpu.parallel.sharded import sharded_counter_agg_for_trace
+    cfg = cell_cfg(alg, "YCSB")
+    fn, tree = sharded_counter_agg_for_trace(cfg)
+    colls = lower_collectives(fn, tree, donate=False)
+    cell = f"{alg}/YCSB/counter-agg"
+    if not colls:
+        raise RuntimeError(f"{cell}: no collectives found in the "
+                           f"lowered aggregator — hlo_scan is broken")
+    findings = check_collectives(colls, contract,
+                                 node_cnt=cfg.node_cnt, cell=cell)
+    if log:
+        log(f"{cell}: {len(colls)} collectives, "
+            f"{len(findings)} finding(s)")
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# the matrix
+
+
+def _sharded_flags(flags=None) -> dict:
+    from deneva_tpu.config import optin_flags
+    all_flags = {n: f for n, f in optin_flags().items()
+                 if "sharded_tick" in f.engines}
+    if flags:
+        all_flags = {n: f for n, f in all_flags.items()
+                     if n in set(flags)}
+    return all_flags
+
+
+def iter_cells(algs, workloads, flags):
+    """(cell label, Config) for the full matrix: per plugin × workload a
+    baseline cell plus one cell per swept opt-in flag, and the ap-mode
+    replication variant (dedicated replica nodes + LSN ack backchannel
+    — the only repl topology the flag sweep's ring default misses)."""
+    n_nodes = _certify_spec()["geometry"]["node_cnt"]
+    for workload in workloads:
+        for alg in algs:
+            cfg = cell_cfg(alg, workload)
+            yield f"{alg}/{workload}/sharded-base", cfg
+            if workload == "YCSB":
+                names = tuple(flags)
+            elif alg in _SWEEP_ALGS_NON_YCSB:
+                names = tuple(n for n in flags
+                              if n in _CORE_DISTRIBUTED_FLAGS)
+            else:
+                names = ()
+            for name in sorted(names):
+                yield (f"{alg}/{workload}/{name}",
+                       cfg.replace(**flags[name].on))
+    if "YCSB" in workloads and "repl_cnt" in flags:
+        for alg in ("NO_WAIT",):
+            if alg in algs:
+                yield (f"{alg}/YCSB/repl_ap",
+                       cell_cfg(alg, "YCSB").replace(
+                           logging=True, repl_cnt=1, repl_mode="ap",
+                           part_cnt=n_nodes // 2))
+
+
+def run_shard_certify(algs=None, workloads=None, flags=None,
+                      log=None) -> list[Finding]:
+    """The full matrix.  Findings come back deduped by (rule, path,
+    line) with a cell count, suppressions applied from source — the
+    same post-processing as engine 3."""
+    import jax
+    from deneva_tpu import cc
+    from deneva_tpu.config import WORKLOADS
+
+    algs = tuple(algs) if algs else tuple(sorted(cc.REGISTRY))
+    workloads = tuple(workloads) if workloads else tuple(WORKLOADS)
+    all_flags = _sharded_flags(flags)
+
+    n_nodes = _certify_spec()["geometry"]["node_cnt"]
+    if len(jax.devices()) < n_nodes:
+        raise RuntimeError(
+            f"certify-sharded needs >= {n_nodes} devices (have "
+            f"{len(jax.devices())}); set XLA_FLAGS="
+            "--xla_force_host_platform_device_count=8 before the first "
+            "jax import")
+
+    contract = load_comm_contract()
+    raw: list[Finding] = []
+    for cell, cfg in iter_cells(algs, workloads, all_flags):
+        raw.extend(certify_cell(cfg, cell, contract, log=log))
+    if "YCSB" in workloads:
+        for alg in algs:
+            raw.extend(certify_agg_cell(alg, contract, log=log))
+    return _dedup_and_suppress(raw)
+
+
+# ---------------------------------------------------------------------------
+# CLI (standalone: python -m deneva_tpu.lint.shard_certify; also reached
+# via python -m deneva_tpu.lint --certify-sharded)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m deneva_tpu.lint.shard_certify",
+        description="sharded collective certifier (lint engine 4)")
+    ap.add_argument("--algs", help="comma-separated CC algorithms "
+                                   "(default: all registered)")
+    ap.add_argument("--workloads", help="comma-separated workloads")
+    ap.add_argument("--flags", help="comma-separated opt-in flag names")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--show-suppressed", action="store_true")
+    ap.add_argument("--quiet", action="store_true",
+                    help="suppress per-cell progress lines")
+    args = ap.parse_args(argv)
+
+    split = lambda s: tuple(x for x in s.split(",") if x) if s else None
+    log = None if args.quiet or args.format == "json" else \
+        (lambda m: print(f"[certify-sharded] {m}", file=sys.stderr))
+    findings = run_shard_certify(algs=split(args.algs),
+                                 workloads=split(args.workloads),
+                                 flags=split(args.flags), log=log)
+    from deneva_tpu.lint.cli import render_json, render_text
+    if args.format == "json":
+        print(render_json(findings))
+    else:
+        print(render_text(findings, args.show_suppressed))
+    return min(sum(not f.suppressed for f in findings), 125)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    _device_env()
+    sys.exit(main())

@@ -19,11 +19,29 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from deneva_tpu.cc.base import CommSpec
 from deneva_tpu.engine.state import NULL_KEY
 from deneva_tpu.ops import segment as seg
 
 #: fill values per routed field
 FILL = {"key": NULL_KEY}
+
+#: This module's declared collectives (cc/base.py COMM_CONTRACT /
+#: CommSpec; certified by lint/shard_certify.py).  The exchange is the
+#: ONLY collective routing may issue: value movement of packed entry
+#: lanes, one all_to_all per routed field per exchange leg, never a
+#: reduction.  round_plan/pack_by_dest/pack_round/unpack stay strictly
+#: shard-local — round_plan is additionally listed in
+#: COMM_CONTRACT["replicated"]: its (dest, held, ts) sort is computed
+#: from shard-local entries, and a cross-partition reduction appearing
+#: inside it is the PR 12 data-plane corruption, not a legal lowering.
+ROUTING_COMM = (
+    CommSpec(name="exchange.ship", op="all_to_all",
+             site=("parallel/routing.py", ("exchange",)),
+             role="data", when="always",
+             note="per-destination entry lanes / decision return legs; "
+                  "one instance per routed field per exchange leg"),
+)
 
 
 def pack_by_dest(dest: jnp.ndarray, prio: jnp.ndarray, live: jnp.ndarray,

@@ -78,9 +78,56 @@ from deneva_tpu.parallel import routing
 from deneva_tpu.workloads.base import QueryPool
 
 AXIS = "node"
+# the communication contract names the axis without importing this
+# module (cc must not import parallel); keep the two declarations fused
+assert AXIS == cc_base.COMM_CONTRACT["axis"], \
+    "parallel/sharded.py AXIS must match cc/base.py COMM_CONTRACT"
 
 SHARD_STAT_KEYS = ("route_overflow_abort_cnt", "commit_defer_cnt",
                    "remote_entry_cnt")
+
+#: Every collective the sharded data plane may lower to, keyed by
+#: (op kind, callsite function) — cc/base.py CommSpec; proved against
+#: the post-partitioning StableHLO by lint/shard_certify.py (engine 4).
+#: routing's exchange specs compose in; everything else the tick ships
+#: cross-node is declared here, including the obs/mesh.py occupancy
+#: extremum (issued from note_occupancy when Config.mesh is on) and the
+#: cluster-counter aggregator psum (a separate jitted shard_map,
+#: lowered and certified via sharded_counter_agg_for_trace).  A
+#: collective matching NO spec is COLLECTIVE-UNDECLARED — the PR 12
+#: class: the SPMD partitioner deciding a "shard-local" value needs a
+#: cross-partition reduction.
+SHARDED_COMM = routing.ROUTING_COMM + (
+    cc_base.CommSpec(
+        name="ts.rebase_extremum", op="all_reduce",
+        site=("parallel/sharded.py", ("tick_fn",)),
+        role="clock", when="always",
+        note="global max of the per-node ts counters gates the 2**31 "
+             "rebase; max is idempotent and order-free"),
+    cc_base.CommSpec(
+        name="rcache.owner_epochs", op="all_gather",
+        site=("parallel/sharded.py", ("tick_fn",)),
+        role="data", when="remote_cache and plugin.remote_cache_ok",
+        note="tick-start gather of (K,) per-bucket owner commit clocks; "
+             "value movement, no reduction"),
+    cc_base.CommSpec(
+        name="repl.log_ship", op="collective_permute",
+        site=("parallel/sharded.py", ("tick_fn",)),
+        role="log", when="logging and repl_cnt > 0",
+        note="ring-successor / dedicated-replica record ship plus the "
+             "ap-mode LSN ack; fixed source_target_pairs, no reduction"),
+    cc_base.CommSpec(
+        name="mesh.occupancy_peak", op="all_reduce",
+        site=("obs/mesh.py", ("note_occupancy",)),
+        role="clock", when="mesh",
+        note="straggler bit: global max of delivered-entry counts"),
+    cc_base.CommSpec(
+        name="counters.cluster_sum", op="all_reduce",
+        site=("parallel/sharded.py", ("agg",)),
+        role="counter", when="summary (host path, separate shard_map)",
+        note="int32 counter planes cross the mesh as exact integer "
+             "sums — the only legal reduction for role=counter"),
+)
 
 
 class ShardState(NamedTuple):
@@ -592,16 +639,20 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
                 return (row_held, row_held_w, row_rmin, row_rwmin,
                         rx_live, rx_fin), None
 
+            # sub-rounds are unrolled at trace time, NOT lax.scan'ed: S
+            # is static, and a scanned body would put the all_to_all
+            # inside a stablehlo.while — the loop-carried collective
+            # the sharded certifier forbids (EXCHANGE-DYNAMIC-ROUND)
+            carry1 = (jnp.zeros(rows_local, jnp.int32),
+                      jnp.zeros(rows_local, jnp.int32),
+                      jnp.full(rows_local, BIG_TS, jnp.int32),
+                      jnp.full(rows_local, BIG_TS, jnp.int32),
+                      jnp.zeros(n_nodes, jnp.int32),
+                      jnp.zeros(n_nodes, jnp.int32))
+            for _r in range(S):
+                carry1, _ = pass1(carry1, jnp.int32(_r))
             (row_held, row_held_w, row_rmin, row_rwmin,
-             rx_live, rx_fin), _ = jax.lax.scan(
-                pass1,
-                (jnp.zeros(rows_local, jnp.int32),
-                 jnp.zeros(rows_local, jnp.int32),
-                 jnp.full(rows_local, BIG_TS, jnp.int32),
-                 jnp.full(rows_local, BIG_TS, jnp.int32),
-                 jnp.zeros(n_nodes, jnp.int32),
-                 jnp.zeros(n_nodes, jnp.int32)),
-                jnp.arange(S, dtype=jnp.int32))
+             rx_live, rx_fin) = carry1
 
             def pass2(acc_c, r):
                 send_r, orig_r = ship_round(r)
@@ -632,9 +683,9 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
                                        {"decbits": acc_c})["decbits"]
                 return acc_c, None
 
-            acc, _ = jax.lax.scan(
-                pass2, jnp.full(nE + 1, 1 << 3, dtype=jnp.int32),
-                jnp.arange(S, dtype=jnp.int32))
+            acc = jnp.full(nE + 1, 1 << 3, dtype=jnp.int32)
+            for _r in range(S):
+                acc, _ = pass2(acc, jnp.int32(_r))
             decb = acc[:nE].reshape(B, R)
             overflow = jnp.zeros(nE, dtype=bool)
             # mesh observatory: one logical request delivery per shipped
@@ -1864,22 +1915,9 @@ class ShardedEngine:
         dicts and no float re-summation of int counters.  float32 time
         integrals stay host-summed in :meth:`summary` (their summation
         order is then pinned, independent of mesh topology)."""
-        tree = {**{("stats", k): v for k, v in state.stats.items()
-                   if not k.startswith("arr_") and v.ndim == 1
-                   and v.dtype == jnp.int32},
-                **{("db", k): v for k, v in state.db.items()
-                   if k.endswith("_cnt") and v.ndim == 1
-                   and v.dtype == jnp.int32}}
+        tree = _counter_tree(state)
         if self._psum_fn is None:
-            spec = P(AXIS)
-
-            def agg(tr):
-                local = jax.tree.map(lambda x: x[0], tr)
-                out = {k: jax.lax.psum(v, AXIS) for k, v in local.items()}
-                return jax.tree.map(lambda x: x[None], out)
-
-            self._psum_fn = jax.jit(shard_map(
-                agg, mesh=self.mesh, in_specs=(spec,), out_specs=spec))
+            self._psum_fn = jax.jit(_counter_agg(self.mesh))
         agg_out = self._psum_fn(tree)
         return {k: int(np.asarray(v)[0]) for (_, k), v in agg_out.items()}
 
@@ -1988,6 +2026,34 @@ class ShardedEngine:
         return int(np.asarray(state.data).sum())
 
 
+def _counter_tree(state: ShardState) -> dict:
+    """The int32 counter planes _cluster_counters aggregates: engine
+    STAT_KEYS_I32 / SHARD_STAT_KEYS / abort taxonomy stats plus the CC
+    plugins' db ``_cnt`` scalars, keyed by their state group."""
+    return {**{("stats", k): v for k, v in state.stats.items()
+               if not k.startswith("arr_") and v.ndim == 1
+               and v.dtype == jnp.int32},
+            **{("db", k): v for k, v in state.db.items()
+               if k.endswith("_cnt") and v.ndim == 1
+               and v.dtype == jnp.int32}}
+
+
+def _counter_agg(mesh):
+    """The unjitted cluster-counter aggregator shard_map closure —
+    shared by _cluster_counters (which jits it) and the sharded
+    collective certifier (which lowers it and proves every counter
+    plane crosses the mesh as an add-reduction, COMM_CONTRACT role
+    ``counter``)."""
+    spec = P(AXIS)
+
+    def agg(tr):
+        local = jax.tree.map(lambda x: x[0], tr)
+        out = {k: jax.lax.psum(v, AXIS) for k, v in local.items()}
+        return jax.tree.map(lambda x: x[None], out)
+
+    return shard_map(agg, mesh=mesh, in_specs=(spec,), out_specs=spec)
+
+
 def sharded_tick_for_trace(cfg: Config, pool=None, devices=None):
     """Uncompiled sharded tick callable + a concrete input state for the
     lint tick certifier (deneva_tpu/lint/certify.py): the unjitted
@@ -1997,3 +2063,13 @@ def sharded_tick_for_trace(cfg: Config, pool=None, devices=None):
     eng = ShardedEngine(cfg, pool=pool, devices=devices)
     eng._build()
     return eng._tick_raw, eng.init_state()
+
+
+def sharded_counter_agg_for_trace(cfg: Config, pool=None, devices=None):
+    """Uncompiled cluster-counter aggregator + its concrete input tree
+    for the sharded collective certifier (lint/shard_certify.py): the
+    same shard_map closure :meth:`ShardedEngine._cluster_counters` jits,
+    over the same counter planes, so the certified artifact IS the
+    production aggregator."""
+    eng = ShardedEngine(cfg, pool=pool, devices=devices)
+    return _counter_agg(eng.mesh), _counter_tree(eng.init_state())

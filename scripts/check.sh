@@ -470,6 +470,23 @@ if [ "$certify_rc" -ne 0 ]; then
     exit "$certify_rc"
 fi
 
+echo "== sharded collective certifier (lint engine 4) =="
+# post-partitioning StableHLO certification of the distributed data
+# plane: lower every plugin x workload x distributed-flag cell through
+# the SPMD partitioner and prove each collective against COMM_CONTRACT
+# (declared site, legal combiner for its role, full-axis grouping, no
+# loop-carried collectives, replicated regions communication-free).
+# Exit code = number of unsuppressed findings.
+timeout -k 10 720 env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+    python -m deneva_tpu.lint --certify-sharded
+shard_certify_rc=$?
+if [ "$shard_certify_rc" -ne 0 ]; then
+    echo "sharded collective certifier FAILED (rc=$shard_certify_rc" \
+         "unsuppressed findings)"
+    exit "$shard_certify_rc"
+fi
+
 echo "== tier-1 pytest =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

@@ -125,6 +125,11 @@ def test_matrix_reconciles_exactly(alg):
     assert tx[:, :, obs_mesh.RESP].sum() > 0
 
 
+# tier-2: engine 4 (lint/shard_certify.py) now proves the split
+# exchange's collective plan statically for every plugin/flag cell, and
+# test_scale_out.py::test_split_exchange_bit_parity_on_oracle_cell is
+# the single tier-1 runtime sentinel for the split path
+@pytest.mark.slow
 def test_split_exchange_reconciles_and_matches_baseline():
     """Config.exchange_split (the capacity-bounded epoch-split
     exchange): the CALVIN cell still reconciles its traffic matrix

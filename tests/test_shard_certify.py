@@ -1,0 +1,360 @@
+"""Sharded-collective-certifier tests (lint engine 4,
+deneva_tpu/lint/shard_certify.py).
+
+Four layers: deliberately-broken shard_map fixtures, each lowered
+through the real SPMD partitioner and rejected with its named rule
+(COLLECTIVE-UNDECLARED / COUNTER-NONCOMMUTATIVE / AXIS-UNDECLARED /
+EXCHANGE-DYNAMIC-ROUND / REPLICATION-DRIFT) — including the resurrected
+PR 12 pitfall, a ``lax.scan``-lowered exchange sub-round loop built
+from the REAL routing helpers and caught by the REAL contract; the
+COMM_CONTRACT autodiscovery guard (every collective call site in
+``parallel/`` must be declared as a CommSpec or excused here, both
+directions); the meta-lint guard that every rule ID of all four engines
+has a catalog row in LINT.md; and the matrix itself — clean cells in
+tier-1, the full matrix under ``-m slow`` (the run scripts/check.sh
+gates on), plus the CLI subprocess exit-code/json seam.
+"""
+
+import ast
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from deneva_tpu.cc.base import COMM_ROLES, CommSpec
+from deneva_tpu.compat import shard_map
+from deneva_tpu.lint import shard_certify
+from deneva_tpu.parallel import routing
+
+pytestmark = pytest.mark.lint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+THIS = "tests/test_shard_certify.py"
+N = 4
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()[:N]), ("node",))
+
+
+def _contract(specs=(), replicated=()):
+    """A fixture contract: real role policy, synthetic site list."""
+    return {"axis": "node", "roles": COMM_ROLES,
+            "replicated": replicated, "specs": specs}
+
+
+def _lower(fn, arg, mesh=None, spec=P("node")):
+    wrapped = shard_map(fn, mesh=mesh or _mesh(),
+                        in_specs=(spec,), out_specs=spec)
+    return shard_certify.lower_collectives(wrapped, arg, donate=False)
+
+
+def _check(colls, contract, node_cnt=N):
+    return shard_certify.check_collectives(colls, contract,
+                                           node_cnt=node_cnt,
+                                           cell="FIXTURE")
+
+
+# ---------------------------------------------------------------------------
+# broken fixtures: each rejected with the named rule
+
+
+def test_fixture_declared_counter_psum_clean():
+    """The positive anchor: a declared role=counter psum over the full
+    node axis passes every check."""
+    def counter_sum(x):
+        return jax.lax.psum(x, "node")
+
+    colls = _lower(counter_sum, jnp.zeros((N, 8), jnp.int32))
+    assert [c.op for c in colls] == ["all_reduce"]
+    spec = CommSpec(name="fix.counter", op="all_reduce",
+                    site=(THIS, ("counter_sum",)),
+                    role="counter", when="always")
+    assert _check(colls, _contract(specs=(spec,))) == []
+
+
+def test_fixture_collective_undeclared():
+    """A psum nobody declared: the partitioner-inserted-reduction bug
+    class, anchored at the collective's own call line."""
+    def rogue_sum(x):
+        return jax.lax.psum(x, "node")
+
+    colls = _lower(rogue_sum, jnp.zeros((N, 8), jnp.int32))
+    found = _check(colls, _contract(specs=()))
+    assert [f.rule for f in found] == ["COLLECTIVE-UNDECLARED"]
+    assert found[0].path.endswith(THIS) and found[0].line > 0
+    assert "all_reduce(add)" in found[0].message
+
+
+def test_fixture_counter_noncommutative():
+    """A max-reduction over a declared counter plane: counters may only
+    cross the mesh via add."""
+    def counter_peak(x):
+        return jax.lax.pmax(x, "node")
+
+    colls = _lower(counter_peak, jnp.zeros((N, 8), jnp.int32))
+    spec = CommSpec(name="fix.counter", op="all_reduce",
+                    site=(THIS, ("counter_peak",)),
+                    role="counter", when="always")
+    found = _check(colls, _contract(specs=(spec,)))
+    assert [f.rule for f in found] == ["COUNTER-NONCOMMUTATIVE"]
+    assert "role=counter" in found[0].message
+    assert "add" in found[0].message
+
+
+def test_fixture_axis_undeclared():
+    """A reduction over a sub-axis of a 2-D mesh: its replica groups
+    cover half the declared node extent each — declared site or not,
+    the grouping is illegal."""
+    mesh2d = Mesh(np.array(jax.devices()[:N]).reshape(2, 2),
+                  ("node", "sub"))
+
+    def sub_sum(x):
+        return jax.lax.psum(x, "sub")
+
+    colls = _lower(sub_sum, jnp.zeros((2, 2, 8), jnp.int32),
+                   mesh=mesh2d, spec=P("node", "sub"))
+    assert [c.op for c in colls] == ["all_reduce"]
+    assert len(colls[0].replica_groups) == 2          # split grouping
+    spec = CommSpec(name="fix.sub", op="all_reduce",
+                    site=(THIS, ("sub_sum",)),
+                    role="counter", when="always")
+    found = _check(colls, _contract(specs=(spec,)))
+    assert [f.rule for f in found] == ["AXIS-UNDECLARED"]
+    assert "'node' axis of 4 nodes" in found[0].message
+
+
+def test_fixture_replication_drift():
+    """A collective originating inside a computation the contract
+    asserts replicated — checked BEFORE site matching, so even a
+    declared spec cannot launder it."""
+    def plan_like(x):
+        # stands in for round_plan: a value every node is supposed to
+        # compute identically, which the partitioner re-reduces instead
+        return jax.lax.psum(x * 2, "node")
+
+    def entry(x):
+        return plan_like(x)
+
+    colls = _lower(entry, jnp.zeros((N, 8), jnp.int32))
+    spec = CommSpec(name="fix.decl", op="all_reduce",
+                    site=(THIS, ("plan_like", "entry")),
+                    role="counter", when="always")
+    found = _check(colls, _contract(
+        specs=(spec,), replicated=((THIS, "plan_like"),)))
+    assert [f.rule for f in found] == ["REPLICATION-DRIFT"]
+    assert "plan_like" in found[0].message
+
+
+def test_fixture_pr12_scan_lowered_exchange():
+    """The resurrected PR 12 pitfall: exchange sub-rounds carried
+    through ``lax.scan`` instead of a trace-time-unrolled Python loop,
+    built from the REAL routing helpers (round_plan / pack_round /
+    exchange) and judged by the REAL composed contract.  Every
+    loop-carried collective must come back EXCHANGE-DYNAMIC-ROUND —
+    the exchange.ship declaration must NOT excuse it — anchored at the
+    loop site in this file."""
+    CAP = 2
+
+    def scan_exchange(keys):
+        k = keys[0]
+        dest = (k % N).astype(jnp.int32)
+        held = jnp.zeros_like(k)
+        sd, sidx, pos, rnd = routing.round_plan(dest, held, k, CAP)
+
+        def sub_round(acc, r):
+            kept = (sd < N) & (rnd == r)
+            send, _ = routing.pack_round(sd, pos % CAP, kept, sidx,
+                                         N, CAP, {"key": k[sidx]})
+            got = routing.exchange(send, "node")
+            return acc + got["key"].sum(), jnp.int32(0)
+
+        acc, _ = jax.lax.scan(sub_round, jnp.int32(0),
+                              jnp.arange(2, dtype=jnp.int32))
+        return keys + acc
+
+    colls = _lower(scan_exchange, jnp.zeros((N, 8), jnp.int32))
+    in_loop = [c for c in colls if c.op == "all_to_all"]
+    assert in_loop, "fixture lost its exchange"
+    assert all(c.in_loop for c in in_loop)
+    found = _check(colls, shard_certify.load_comm_contract())
+    rules = {f.rule for f in found}
+    assert rules == {"EXCHANGE-DYNAMIC-ROUND"}, found
+    f = next(iter(found))
+    assert f.path.endswith(THIS), f.path    # the loop site, this file
+    assert f.line > 0
+    assert "while" in f.message
+
+
+def test_fixture_static_unroll_is_clean():
+    """The remediation the rule's fix text prescribes: the same
+    sub-round structure unrolled at trace time passes the real
+    contract."""
+    CAP = 2
+
+    def unrolled_exchange(keys):
+        k = keys[0]
+        dest = (k % N).astype(jnp.int32)
+        held = jnp.zeros_like(k)
+        sd, sidx, pos, rnd = routing.round_plan(dest, held, k, CAP)
+        acc = jnp.int32(0)
+        for r in range(2):                  # static trip count
+            kept = (sd < N) & (rnd == r)
+            send, _ = routing.pack_round(sd, pos % CAP, kept, sidx,
+                                         N, CAP, {"key": k[sidx]})
+            got = routing.exchange(send, "node")
+            acc = acc + got["key"].sum()
+        return keys + acc
+
+    colls = _lower(unrolled_exchange, jnp.zeros((N, 8), jnp.int32))
+    assert sum(c.op == "all_to_all" for c in colls) == 2
+    assert not any(c.in_loop for c in colls)
+    found = _check(colls, shard_certify.load_comm_contract())
+    assert found == []
+
+
+# ---------------------------------------------------------------------------
+# COMM_CONTRACT autodiscovery guard (parallel/ call sites <-> CommSpecs)
+
+#: jax.lax collective callables -> StableHLO kind they lower to
+_LAX_COLLECTIVES = {
+    "psum": "all_reduce", "pmax": "all_reduce", "pmin": "all_reduce",
+    "ppermute": "collective_permute", "pshuffle": "collective_permute",
+    "all_to_all": "all_to_all", "all_gather": "all_gather",
+    "psum_scatter": "reduce_scatter",
+}
+
+#: (relpath, enclosing def, kind) call sites excused from declaration,
+#: with the reason — empty today; a new entry needs the same scrutiny
+#: as a lint suppression
+_EXCUSED: dict = {}
+
+
+def _collective_call_sites():
+    """AST-discovered collective call sites under parallel/: (relpath,
+    innermost enclosing def, lowered kind, line)."""
+    sites = []
+    pkg = os.path.join(REPO, "deneva_tpu", "parallel")
+    for fname in sorted(os.listdir(pkg)):
+        if not fname.endswith(".py"):
+            continue
+        path = os.path.join(pkg, fname)
+        rel = os.path.relpath(path, REPO)
+        with open(path, encoding="utf-8") as fh:
+            tree = ast.parse(fh.read())
+
+        def walk(node, func):
+            for child in ast.iter_child_nodes(node):
+                name = func
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    name = child.name
+                if isinstance(child, ast.Call):
+                    callee = child.func
+                    attr = (callee.attr
+                            if isinstance(callee, ast.Attribute)
+                            else callee.id
+                            if isinstance(callee, ast.Name) else None)
+                    if attr in _LAX_COLLECTIVES:
+                        sites.append((rel, func, _LAX_COLLECTIVES[attr],
+                                      child.lineno))
+                walk(child, name)
+
+        walk(tree, "<module>")
+    return sites
+
+
+def test_autodiscovery_guard_every_call_site_declared():
+    """Both directions: every collective call site in parallel/ must
+    match a CommSpec (or carry an excuse above), and every CommSpec
+    whose site lies under parallel/ must still have a live call site —
+    new cross-node traffic cannot ship undeclared, and the contract
+    cannot go stale."""
+    from deneva_tpu.parallel.sharded import SHARDED_COMM
+    sites = _collective_call_sites()
+    assert sites, "AST scan found no collective call sites at all"
+
+    def covered(rel, func, kind):
+        return any(s.op == kind and rel.endswith(s.site[0])
+                   and func in s.site[1] for s in SHARDED_COMM)
+
+    undeclared = [(rel, func, kind, line)
+                  for rel, func, kind, line in sites
+                  if not covered(rel, func, kind)
+                  and (rel, func, kind) not in _EXCUSED]
+    assert undeclared == [], (
+        f"collective call sites {undeclared} are neither declared as a "
+        "CommSpec (parallel/routing.py ROUTING_COMM / parallel/"
+        "sharded.py SHARDED_COMM) nor excused in _EXCUSED with a "
+        "reason — the sharded certifier cannot prove undeclared "
+        "traffic")
+    assert all(_EXCUSED.values()), "bare _EXCUSED entry without reason"
+
+    live = {(rel, func, kind) for rel, func, kind, _ in sites}
+    stale = [s.name for s in SHARDED_COMM
+             if "parallel/" in s.site[0]
+             and not any(rel.endswith(s.site[0]) and func in s.site[1]
+                         and s.op == kind
+                         for rel, func, kind in live)]
+    assert stale == [], f"CommSpecs {stale} match no call site anymore"
+
+
+# ---------------------------------------------------------------------------
+# meta-lint guard: rule docs cannot drift (all four engines)
+
+
+def test_every_rule_id_has_a_lint_md_catalog_row():
+    from deneva_tpu.lint.rules import RULES
+    with open(os.path.join(REPO, "LINT.md"), encoding="utf-8") as fh:
+        doc = fh.read()
+    rows = [ln for ln in doc.splitlines()
+            if ln.lstrip().startswith("|")]
+    missing = [rid for rid in RULES
+               if not any(f"`{rid}`" in ln for ln in rows)]
+    assert missing == [], (
+        f"rules {missing} are registered in lint/rules.py but have no "
+        "catalog row in LINT.md — document the rule (or delete it)")
+
+
+# ---------------------------------------------------------------------------
+# the matrix
+
+
+def test_shard_certify_small_cells_clean():
+    """Real cells covering every declared collective: CALVIN's split
+    exchange, MAAT's remote-cache gather, the repl permutes, the mesh
+    extremum, and the counter-agg psums — the tier-1 anchor."""
+    found = shard_certify.run_shard_certify(
+        algs=("CALVIN", "MAAT"), workloads=("YCSB",),
+        flags=("exchange_split", "remote_cache", "repl_cnt", "mesh"))
+    assert [f for f in found if not f.suppressed] == [], \
+        [f"{f.rule} {f.location()}: {f.message}" for f in found]
+
+
+@pytest.mark.slow
+def test_shard_certify_full_matrix_clean():
+    """The acceptance criterion: 0 unsuppressed findings over the full
+    plugin x workload x distributed-flag matrix (same run
+    scripts/check.sh gates on)."""
+    found = shard_certify.run_shard_certify()
+    assert [f for f in found if not f.suppressed] == [], \
+        [f"{f.rule} {f.location()}: {f.message}" for f in found
+         if not f.suppressed]
+
+
+def test_shard_certify_cli_exit_code_and_json():
+    import json
+    import subprocess
+    import sys
+    out = subprocess.run(
+        [sys.executable, "-m", "deneva_tpu.lint.shard_certify",
+         "--algs", "WAIT_DIE", "--workloads", "YCSB",
+         "--flags", "mesh", "--format", "json"],
+        capture_output=True, text=True, timeout=300, cwd=REPO)
+    assert out.returncode == 0, out.stderr[-2000:]
+    doc = json.loads(out.stdout)
+    assert doc["unsuppressed"] == 0
+    assert isinstance(doc["findings"], list)
