@@ -588,6 +588,7 @@ def run_scaling_grid(args, out_dir: str = "results",
     # test_mesh.py builds a bare Namespace, hence getattr defaults.
     rc_on = getattr(args, "grid_remote_cache", False)
     split_on = getattr(args, "grid_split", False)
+    pipe_on = getattr(args, "pipeline", False)
 
     def grid_cfg(alg, n, b):
         extra = {}
@@ -595,6 +596,13 @@ def run_scaling_grid(args, out_dir: str = "results",
             extra["remote_cache"] = True
         if n > 1 and split_on:
             extra["exchange_split"] = True
+        if n > 1 and pipe_on:
+            # the Config constructor does not apply _optin on-dicts, so
+            # the pipelined cells set BOTH flags (pipeline_exchange's
+            # on-dict implies exchange_split); inert on abort-capable
+            # plugins — run with --algs CALVIN for live pipelined cells
+            extra["exchange_split"] = True
+            extra["pipeline_exchange"] = True
         return Config(cc_alg=alg, node_cnt=n, part_cnt=n, batch_size=b,
                       part_per_txn=min(2, n), mesh=True, **GRID_KW,
                       **extra)
@@ -675,18 +683,33 @@ def run_scaling_grid(args, out_dir: str = "results",
                     cell["remote_attempts"] = s["remote_attempt_cnt"]
                     cell["reship_suppressed"] = s["reship_suppressed_cnt"]
                     cell["remote_cache_hits"] = s["remote_cache_hit_cnt"]
+                # software-pipeline occupancy (Config.pipeline_exchange
+                # live on this cell): the fraction of issued exchange
+                # legs that overlapped another leg of their pass
+                if "pipe_leg_cnt" in s:
+                    cell["pipeline_overlap_frac"] = round(
+                        s["pipe_overlap_cnt"] / max(s["pipe_leg_cnt"], 1),
+                        4)
                 grid[alg].append(cell)
-                # flagged cells key their own trajectory: '+rc'/'+split'
-                # numbers must not shift the baseline medians the
-                # obs/regress.py gate compares against
+                # flagged cells key their own trajectory:
+                # '+rc'/'+split'/'+pipe' numbers must not shift the
+                # baseline medians the obs/regress.py gate compares
+                # against
                 tag = (("+rc" if (n > 1 and rc_on) else "")
-                       + ("+split" if (n > 1 and split_on) else ""))
+                       + ("+split" if (n > 1 and split_on) else "")
+                       + ("+pipe" if (n > 1 and pipe_on) else ""))
                 cells_hist[f"{alg}@{n}x{b}{tag}"] = {
                     "commits_per_tick": cell["commits_per_tick"],
                     "efficiency": cell["efficiency"],
                     # remote amplification, gated INVERTED by
                     # obs/regress.py (growing ratio = regression)
                     "amplification": cell["remote_ratio"]}
+                if "pipeline_overlap_frac" in cell:
+                    # self-arms an obs/regress.py floor for the
+                    # pipelined cells' overlap fraction
+                    cells_hist[f"{alg}@{n}x{b}{tag}"][
+                        "pipeline_overlap_frac"] = \
+                        cell["pipeline_overlap_frac"]
                 print(f"[scaling-grid] {alg} n={n} B={b}{tag}: "
                       f"{cell['commits_per_tick']} commits/tick, "
                       f"speedup {cell['speedup']} "
@@ -1321,6 +1344,14 @@ def _cli():
                         "Config.exchange_split (capacity-bounded "
                         "epoch-split exchange) on every multi-node "
                         "cell; cells key their own '+split' trajectory")
+    p.add_argument("--pipeline", action="store_true",
+                   help="run the --scaling-grid cells with "
+                        "Config.pipeline_exchange (software-pipelined "
+                        "split exchange, implies exchange_split) on "
+                        "every multi-node cell; cells key their own "
+                        "'+pipe' trajectory and carry "
+                        "pipeline_overlap_frac (use --algs CALVIN — "
+                        "the flag is inert on abort-capable plugins)")
     p.add_argument("--grid-budget-mb", type=float, default=256.0,
                    help="per-node HBM budget feeding the fit_batch "
                         "model that sizes the large --scaling-grid "

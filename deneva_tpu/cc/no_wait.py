@@ -63,7 +63,8 @@ class TwoPLPlugin(CCPlugin):
             assert cfg.acquire_window == 1, "sub_ticks needs window=1"
             g, w, a = twopl.arbitrate_subticked(
                 txn, active, self.policy, cfg.sub_ticks,
-                read_locks_held=(cfg.isolation_level == SERIALIZABLE))
+                read_locks_held=(cfg.isolation_level == SERIALIZABLE),
+                pipelined=cfg.pipeline_exchange)
             return AccessDecision(
                 grant=g, wait=w, abort=a,
                 reason=static_reason(cfg, self.access_abort_reasons[0],

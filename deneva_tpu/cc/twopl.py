@@ -310,7 +310,8 @@ def ts_groups(ts, active, K: int):
 
 
 def arbitrate_subticked(txn, active, policy: str, K: int,
-                        read_locks_held: bool = True):
+                        read_locks_held: bool = True,
+                        pipelined: bool = False):
     """Arbitrate one tick's requests in K timestamp-ordered sub-rounds.
 
     The one-round tick decides all requests against the tick-START lock
@@ -322,6 +323,18 @@ def arbitrate_subticked(txn, active, policy: str, K: int,
     groups: group k arbitrates against the lock state left by groups < k
     (grants added, aborted txns' locks removed).  K -> B converges to the
     sequential reference's schedule; PARITY.md quantifies divergence vs K.
+
+    ``pipelined`` (Config.pipeline_exchange) software-pipelines the
+    sub-rounds: every round's request plane is materialized BEFORE the
+    serial grant chain, so round k+1's entry packing is free to run
+    while round k's arbitration sort lands.  Sound because a group-k txn
+    cannot be dead before round k — :func:`arbitrate` only sets abort
+    bits at request positions (holders are never wounded), and a txn's
+    sole request lane enters at exactly its own group's round — so the
+    ``~dead`` term in the request mask is redundant and the plane is
+    round-invariant.  The held mask (which DOES depend on earlier
+    rounds' grants and deaths) stays in the serial chain; every value
+    is bit-identical to the in-order loop.
 
     Requires acquire_window == 1 (one request per txn per tick, the
     faithful state machine).  Returns (grant, wait, abort) (B, R) masks.
@@ -346,10 +359,20 @@ def arbitrate_subticked(txn, active, policy: str, K: int,
     tse = jnp.broadcast_to(txn.ts[:, None], (B, R))
     txe = jnp.broadcast_to(jnp.arange(B, dtype=jnp.int32)[:, None], (B, R))
 
+    req_rounds = None
+    if pipelined:
+        # hoisted request planes: issued up front, outside the serial
+        # G/dead carry, so the compiler may overlap them with any round
+        req_rounds = [req_base & (active & (group == k))[:, None]
+                      for k in range(K)]
+
     for k in range(K):
-        grp = active & (group == k) & ~dead
         held_m = (held_base | G) & ~dead[:, None]
-        req_m = req_base & grp[:, None]
+        if pipelined:
+            req_m = req_rounds[k]
+        else:
+            grp = active & (group == k) & ~dead
+            req_m = req_base & grp[:, None]
         live = held_m | req_m
         ent = Entries(
             key=flat(jnp.where(live, txn.keys, NULL_KEY)),

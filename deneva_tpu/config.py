@@ -541,6 +541,33 @@ class Config:
     exchange_split: bool = _optin(False, {"exchange_split": True},
                                   engines=("sharded_tick",))
 
+    #: pipelined sharded ticks (parallel/sharded.py): when True, the
+    #: epoch-split exchange's trace-time-unrolled sub-round loops are
+    #: software-pipelined — sub-round k+1's shard-local pack (round_plan
+    #: windows, ops/segment.py scans) and its all_to_all are ISSUED, in
+    #: trace order, before sub-round k's received lanes are consumed, so
+    #: XLA's async collective scheduler can overlap the ICI transfer
+    #: with shard-local compute; the owner-side decision read-off
+    #: likewise overlaps the previous round's response fan-out, and the
+    #: commit exchange (pass B) issues round k+1's lanes before applying
+    #: round k's serial db carry.  One level down, the single-chip
+    #: engine pipelines the ``sub_ticks`` arbitration rounds the same
+    #: way (cc/twopl.py arbitrate_subticked): each round's request
+    #: planes are hoisted out of the serial grant chain so round k+1's
+    #: entry materialization runs while round k's arbitration sort
+    #: lands.  Pure dataflow reorder at trace time — every value is
+    #: bit-identical to the unpipelined tick (the loops stay UNROLLED:
+    #: a dynamic ``while`` re-triggers the SPMD-partitioner corruption
+    #: the engine-4 EXCHANGE-DYNAMIC-ROUND rule guards).  Sharded leg
+    #: requires ``exchange_split`` (and its never-aborts plugin gate);
+    #: single-chip leg requires ``sub_ticks > 1``; inert otherwise.
+    #: Adds ``pipe_leg_cnt`` / ``pipe_overlap_cnt`` (issued exchange
+    #: legs / legs issued with another stage in flight) when live on the
+    #: sharded path.  Off by default — byte-identical off path.
+    pipeline_exchange: bool = _optin(
+        False, {"pipeline_exchange": True, "exchange_split": True},
+        engines=("sharded_tick",))
+
     #: remote-grant stickiness (parallel/sharded.py): when True, plugins
     #: that opt in (``remote_cache_ok`` — MAAT's forced-grant access)
     #: carry a device-resident per-txn remote-decision cache: ``(B, R)``

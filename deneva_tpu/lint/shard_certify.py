@@ -55,7 +55,8 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
 #: distributed-semantics core (the flags that add or reshape cross-node
 #: traffic) on the engine-3 representative plugins — observability
 #: flags are workload-independent and YCSB already proves them
-_CORE_DISTRIBUTED_FLAGS = ("exchange_split", "remote_cache", "repl_cnt",
+_CORE_DISTRIBUTED_FLAGS = ("exchange_split", "pipeline_exchange",
+                           "remote_cache", "repl_cnt",
                            "mesh", "faults", "adaptive", "slo",
                            "net_delay_ticks")
 _SWEEP_ALGS_NON_YCSB = ("NO_WAIT", "MAAT")

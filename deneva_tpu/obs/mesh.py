@@ -289,6 +289,24 @@ def note_occupancy(stats: dict, per_dest, axis_name: str, measuring
             + strag.astype(jnp.int32)}
 
 
+def note_round_windows(stats: dict, per_dest, n_self, cap: int, measuring
+                       ) -> dict:
+    """Mesh-side sub-round bookkeeping for the epoch-split exchange:
+    the number of capacity windows implied by the DELIVERED per-dest
+    counts — ``ceil(max(per_dest, n_self) / cap)``, with the self lane
+    (excluded from ``per_dest`` on the split path) supplied separately.
+    ceil is monotone, so the max-of-ceils the engine's round_plan counts
+    (``exchange_round_cnt``) equals this ceil-of-max exactly, and the
+    split path drops nothing structurally — :func:`reconcile` pins the
+    per-node identity ``mesh_round_sum == exchange_round_cnt``."""
+    if "mesh_round_sum" not in stats or per_dest is None:
+        return stats
+    busiest = jnp.maximum(jnp.max(per_dest), jnp.asarray(n_self, jnp.int32))
+    rounds = (busiest + (cap - 1)) // cap
+    return {**stats, "mesh_round_sum":
+            stats["mesh_round_sum"] + jnp.where(measuring, rounds, 0)}
+
+
 def note_trace(stats: dict, t, per_dest) -> dict:
     """Per-dest sent counts into the companion ring (wrap-and-accumulate,
     NOT warmup-gated — the trace-ring discipline of obs/trace.py)."""
@@ -339,6 +357,10 @@ def snapshot(state_or_stats) -> dict:
         "commits": per("txn_cnt"),
         "aborts": per("total_txn_abort_cnt"),
         "remote": per("remote_entry_cnt"),
+        # epoch-split exchange only: the engine's occupied sub-round
+        # count and the mesh-side window count it must equal
+        "rounds": per("exchange_round_cnt"),
+        "round_sum": per("mesh_round_sum"),
         "measured_ticks": int(np.asarray(stats["measured_ticks"]).max()),
     }
     if "arr_mesh_inflight" in stats:
@@ -369,6 +391,17 @@ def reconcile(snap: dict, summary: dict) -> list:
             if int(attempts[i]) != int(snap["remote"][i]):
                 bad.append((f"remote_entry[{i}]", int(attempts[i]),
                             int(snap["remote"][i])))
+    # epoch-split exchange: the mesh-side window count derived from the
+    # delivered per-dest traffic lands exactly on the engine's
+    # round_plan bookkeeping, per node (zero drops structurally on the
+    # split path, and ceil-of-max == max-of-ceil) — so drops, occupancy
+    # and rounds balance in one identity set
+    if snap.get("rounds") is not None and snap.get("round_sum") is not None:
+        for i in range(snap["nodes"]):
+            if int(snap["round_sum"][i]) != int(snap["rounds"][i]):
+                bad.append((f"round_windows[{i}]",
+                            int(snap["round_sum"][i]),
+                            int(snap["rounds"][i])))
     # remote-grant stickiness (Config.remote_cache): every attempted
     # remote entry either shipped or was answered from the cache —
     # attempts == shipped (remote_entry_cnt) + suppressed, cluster-wide

@@ -137,6 +137,19 @@ def _entry(source: str, order: tuple, doc: dict) -> Optional[dict]:
             except (TypeError, ValueError):
                 continue
     out["scaling_amp"] = amp
+    # pipelined grid cells (bench.py --scaling-grid --pipeline) carry
+    # the software-pipeline overlap fraction (overlapped exchange legs
+    # per issued leg, Config.pipeline_exchange); gated as a FLOOR —
+    # overlap collapsing means the exchange re-serialized — self-arming
+    # like the efficiency cells
+    pov = {}
+    for cell_key, cell in (doc.get("scaling_grid") or {}).items():
+        if isinstance(cell, dict) and "pipeline_overlap_frac" in cell:
+            try:
+                pov[cell_key] = float(cell["pipeline_overlap_frac"])
+            except (TypeError, ValueError):
+                continue
+    out["pipeline_overlap"] = pov
     # adaptive-controller sweep records (bench.py --adaptive) carry one
     # adaptive-over-best-static commits/tick ratio per (alg, contention)
     # cell; same normalize-to-empty discipline, so the floor self-arms
@@ -319,6 +332,18 @@ def gate(entries: list[dict], current: Optional[dict] = None,
                       [e["scaling_amp"][cell_key] for e in prior
                        if cell_key in e.get("scaling_amp", {})],
                       cpt_tolerance)
+    # pipeline-overlap trajectory (--scaling-grid --pipeline records):
+    # a pipelined cell's overlap fraction collapsing means the split
+    # exchange's issue order re-serialized (the compiler stopped
+    # overlapping the collectives with shard-local compute) — gated as
+    # a floor at the shared schedule-pure tolerance, self-arming once a
+    # pipelined run lands in the history
+    for cell_key, cur in sorted(current.get("pipeline_overlap",
+                                            {}).items()):
+        check(f"pipeline_overlap_frac[{cell_key}]", cur,
+              [e["pipeline_overlap"][cell_key] for e in prior
+               if cell_key in e.get("pipeline_overlap", {})],
+              cpt_tolerance)
     # adaptive-vs-static trajectory (--adaptive records): a cell's ratio
     # dropping means the controller's closed loop wins less over the best
     # hand-tuned static backoff than it used to — schedule-pure like

@@ -65,6 +65,16 @@ _COMPACT = ("live_entries", "compact_ovf")
 CTRL_COLUMNS = ("esc_active", "width_idx", "occ_ewma", "heat_max",
                 "backoff_base_max", "escalations", "esc_blocked")
 
+#: software-pipeline companion ring schema (Config.pipeline_exchange,
+#: parallel/sharded.py): per tick, the exchange legs issued by the
+#: split-exchange passes and how many of them were issued while another
+#: leg of the same pass was still in flight (the double buffer keeps
+#: exactly one collective outstanding, so legs - occupied_passes is the
+#: overlapped count).  The Perfetto "pipeline occupancy" track and the
+#: host-side ``pipeline_overlap_frac`` (bench.py / obs/regress.py) both
+#: derive from these two columns.
+PIPE_COLUMNS = ("pipe_legs", "pipe_overlap")
+
 
 def init_trace(cfg, lat_samples: int) -> dict:
     """Stats-dict entries for the timeline; empty when tracing is off
@@ -181,6 +191,23 @@ def record_ctrl(stats: dict, t) -> dict:
                 row, unique_indices=True)}
 
 
+def record_pipe(stats: dict, t, legs, lapped) -> dict:
+    """Accumulate the tick's pipeline-occupancy row — issued exchange
+    legs and legs issued with another leg of the same pass in flight
+    (parallel/sharded.py computes both from the occupied sub-round
+    counts).  Same wrap-and-accumulate discipline — and the same warmup
+    caveat — as :func:`record_tick`; no-op unless the run traces with
+    ``Config.pipeline_exchange`` on the split path."""
+    if "arr_pipe_trace" not in stats:
+        return stats
+    buf = stats["arr_pipe_trace"]
+    row = jnp.stack([jnp.asarray(legs, jnp.int32),
+                     jnp.asarray(lapped, jnp.int32)])
+    return {**stats,
+            "arr_pipe_trace": buf.at[t % buf.shape[0]].add(
+                row, unique_indices=True)}
+
+
 def record_slo(cfg, stats: dict, t) -> dict:
     """Record the SLO plane's per-family device-side gauges — the
     bucket-low p99 estimate (ticks) and the CUMULATIVE error-budget
@@ -243,6 +270,13 @@ def _ctrl_buffer(state_or_stats) -> np.ndarray | None:
     return np.asarray(stats["arr_ctrl_trace"])
 
 
+def _pipe_buffer(state_or_stats) -> np.ndarray | None:
+    stats = getattr(state_or_stats, "stats", state_or_stats)
+    if "arr_pipe_trace" not in stats:
+        return None
+    return np.asarray(stats["arr_pipe_trace"])
+
+
 def _slo_buffer(state_or_stats) -> np.ndarray | None:
     stats = getattr(state_or_stats, "stats", state_or_stats)
     if "arr_slo_trace" not in stats:
@@ -277,6 +311,7 @@ def timeline(state_or_stats, per_shard: bool = False) -> dict:
     m = _mesh_buffer(state_or_stats)      # stacked: (N, trace_ticks, N)
     c = _ctrl_buffer(state_or_stats)
     sl = _slo_buffer(state_or_stats)
+    p = _pipe_buffer(state_or_stats)
     if a.ndim == 3 and not per_shard:
         a = a.sum(axis=0)
         r = r.sum(axis=0) if r is not None else None
@@ -284,6 +319,7 @@ def timeline(state_or_stats, per_shard: bool = False) -> dict:
         m = m.sum(axis=0) if m is not None else None
         c = c.sum(axis=0) if c is not None else None
         sl = sl.sum(axis=0) if sl is not None else None
+        p = p.sum(axis=0) if p is not None else None
     if a.ndim == 3:
         out = {name: a[:, :, i] for i, name in enumerate(TRACE_COLUMNS)}
         if r is not None:
@@ -300,6 +336,9 @@ def timeline(state_or_stats, per_shard: bool = False) -> dict:
         if sl is not None:
             out.update({name: sl[:, :, i] for i, name
                         in enumerate(_slo_names(sl.shape[-1]))})
+        if p is not None:
+            out.update({name: p[:, :, i]
+                        for i, name in enumerate(PIPE_COLUMNS)})
         return out
     out = {name: a[:, i] for i, name in enumerate(TRACE_COLUMNS)}
     if r is not None:
@@ -315,6 +354,8 @@ def timeline(state_or_stats, per_shard: bool = False) -> dict:
     if sl is not None:
         out.update({name: sl[:, i] for i, name
                     in enumerate(_slo_names(sl.shape[-1]))})
+    if p is not None:
+        out.update({name: p[:, i] for i, name in enumerate(PIPE_COLUMNS)})
     return out
 
 
@@ -377,6 +418,10 @@ def to_chrome_trace(state_or_stats, path: str, n_ticks: int | None = None,
     sshards = None
     if sbuf is not None:
         sshards = sbuf[None] if sbuf.ndim == 2 else sbuf
+    pbuf = _pipe_buffer(state_or_stats)
+    pshards = None
+    if pbuf is not None:
+        pshards = pbuf[None] if pbuf.ndim == 2 else pbuf
     rnames = _reason_names()
     N, T, _ = shards.shape
     if n_ticks is not None:
@@ -448,6 +493,17 @@ def to_chrome_trace(state_or_stats, path: str, n_ticks: int | None = None,
                                         for i, c in enumerate(
                                             _slo_names(
                                                 sshards.shape[-1]))}})
+            if pshards is not None:
+                # 10th counter track (same conditional discipline):
+                # the split exchange's software-pipeline occupancy —
+                # issued collective legs vs legs issued with another
+                # leg in flight (Config.pipeline_exchange with tracing;
+                # parallel/sharded.py)
+                events.append({"name": "pipeline occupancy", "ph": "C",
+                               "ts": ts, "pid": node,
+                               "args": {c: int(pshards[node][t, i])
+                                        for i, c in
+                                        enumerate(PIPE_COLUMNS)}})
     xentries = []
     if xmeter:
         # 5th counter track, present only when an xmeter snapshot is
@@ -486,6 +542,8 @@ def to_chrome_trace(state_or_stats, path: str, n_ticks: int | None = None,
         doc["metadata"]["ctrl_track"] = list(CTRL_COLUMNS)
     if sshards is not None:
         doc["metadata"]["slo_track"] = list(_slo_names(sshards.shape[-1]))
+    if pshards is not None:
+        doc["metadata"]["pipe_track"] = list(PIPE_COLUMNS)
     if xentries:
         doc["metadata"]["xmeter_entries"] = xentries
     if flight:

@@ -40,7 +40,12 @@ ROUTING_COMM = (
              site=("parallel/routing.py", ("exchange",)),
              role="data", when="always",
              note="per-destination entry lanes / decision return legs; "
-                  "one instance per routed field per exchange leg"),
+                  "one instance per routed field per exchange leg.  "
+                  "Config.pipeline_exchange reorders the ISSUE order of "
+                  "the split-exchange legs (sub-round k+1 ships before "
+                  "sub-round k's recv is consumed) but every leg still "
+                  "lowers through this frame — the pipelined matrix "
+                  "cell certifies against this same spec"),
 )
 
 

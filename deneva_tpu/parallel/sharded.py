@@ -208,6 +208,14 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
     # remote_cache_ok).  Mutually exclusive by trait; each flag is inert
     # (baseline jaxpr) for plugins outside its trait.
     split = cfg.exchange_split and plugin.never_aborts
+    # software-pipelined sub-rounds (Config.pipeline_exchange): a pure
+    # trace-order restructure of the split exchange's unrolled loops —
+    # round k+1's pack/all_to_all is issued before round k's received
+    # lanes are consumed, so the async collective scheduler can overlap
+    # ICI with shard-local compute.  Dataflow (and therefore every
+    # value) is identical to the in-order loops; inert without the
+    # split path.
+    pipe = cfg.pipeline_exchange and split
     rcache = cfg.remote_cache and plugin.remote_cache_ok and normal
     if split:
         # the split path computes the deterministic FIFO grant from
@@ -607,11 +615,9 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
                 return routing.pack_round(sd_s, pos_s - r * cap, kept_r,
                                           idx_s, n_nodes, cap, fields_s)
 
-            def pass1(carry, r):
+            def pass1_consume(carry, recv_r):
                 (row_held, row_held_w, row_rmin, row_rwmin,
                  rx_live, rx_fin) = carry
-                send_r, _ = ship_round(r)
-                recv_r = routing.exchange(send_r, AXIS)
                 o_key = recv_r["key"].reshape(-1)
                 o_live = o_key != NULL_KEY
                 o_flags = recv_r["flags"].reshape(-1)
@@ -637,7 +643,7 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
                 rx_fin = rx_fin + jnp.where(
                     notself, rfin.sum(axis=1).astype(jnp.int32), 0)
                 return (row_held, row_held_w, row_rmin, row_rwmin,
-                        rx_live, rx_fin), None
+                        rx_live, rx_fin)
 
             # sub-rounds are unrolled at trace time, NOT lax.scan'ed: S
             # is static, and a scanned body would put the all_to_all
@@ -649,14 +655,29 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
                       jnp.full(rows_local, BIG_TS, jnp.int32),
                       jnp.zeros(n_nodes, jnp.int32),
                       jnp.zeros(n_nodes, jnp.int32))
-            for _r in range(S):
-                carry1, _ = pass1(carry1, jnp.int32(_r))
+            if pipe:
+                # double buffer: round r+1's pack + all_to_all are
+                # issued, in trace order, before round r's recv is
+                # consumed — the scatter accumulation of one round
+                # overlaps the next round's collective.  Same dataflow,
+                # still S unrolled ship/consume pairs.
+                recv_pend = routing.exchange(
+                    ship_round(jnp.int32(0))[0], AXIS)
+                for _r in range(S):
+                    recv_r = recv_pend
+                    if _r + 1 < S:
+                        recv_pend = routing.exchange(
+                            ship_round(jnp.int32(_r + 1))[0], AXIS)
+                    carry1 = pass1_consume(carry1, recv_r)
+            else:
+                for _r in range(S):
+                    send_r, _ = ship_round(jnp.int32(_r))
+                    carry1 = pass1_consume(
+                        carry1, routing.exchange(send_r, AXIS))
             (row_held, row_held_w, row_rmin, row_rwmin,
              rx_live, rx_fin) = carry1
 
-            def pass2(acc_c, r):
-                send_r, orig_r = ship_round(r)
-                recv_r = routing.exchange(send_r, AXIS)
+            def pass2_decide(recv_r):
                 o_key = recv_r["key"].reshape(-1)
                 o_live = o_key != NULL_KEY
                 o_flags = recv_r["flags"].reshape(-1)
@@ -672,20 +693,47 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
                 else:
                     # NOCC ladder: every request grants at its owner
                     g = o_req
-                decbits_r = (g.astype(jnp.int32)
-                             | ((o_req & ~g).astype(jnp.int32) << 1)
-                             | (jnp.int32(1) << 3))
-                ret_r = routing.exchange(
-                    {"decbits": decbits_r.reshape(n_nodes, cap)}, AXIS)
-                # each lane belongs to exactly one sub-round; the others
-                # leave its accumulator cell untouched
-                acc_c = routing.unpack(ret_r, orig_r, nE,
-                                       {"decbits": acc_c})["decbits"]
-                return acc_c, None
+                return (g.astype(jnp.int32)
+                        | ((o_req & ~g).astype(jnp.int32) << 1)
+                        | (jnp.int32(1) << 3))
 
             acc = jnp.full(nE + 1, 1 << 3, dtype=jnp.int32)
-            for _r in range(S):
-                acc, _ = pass2(acc, jnp.int32(_r))
+            if pipe:
+                # both legs interleave: round r+1's forward exchange is
+                # in flight while round r's owner read-off runs, and
+                # round r's decbits return leg is in flight while round
+                # r+1 ships — its unpack scatter is deferred one round.
+                # Each lane belongs to exactly one sub-round, so the
+                # deferred scatters touch disjoint accumulator cells and
+                # the reorder is pure dataflow.
+                send_r, orig_cur = ship_round(jnp.int32(0))
+                fwd = routing.exchange(send_r, AXIS)
+                pend = None
+                for _r in range(S):
+                    recv_r, orig_r = fwd, orig_cur
+                    if _r + 1 < S:
+                        send_n, orig_cur = ship_round(jnp.int32(_r + 1))
+                        fwd = routing.exchange(send_n, AXIS)
+                    ret_r = routing.exchange(
+                        {"decbits": pass2_decide(recv_r).reshape(
+                            n_nodes, cap)}, AXIS)
+                    if pend is not None:
+                        acc = routing.unpack(pend[0], pend[1], nE,
+                                             {"decbits": acc})["decbits"]
+                    pend = (ret_r, orig_r)
+                acc = routing.unpack(pend[0], pend[1], nE,
+                                     {"decbits": acc})["decbits"]
+            else:
+                for _r in range(S):
+                    send_r, orig_r = ship_round(jnp.int32(_r))
+                    recv_r = routing.exchange(send_r, AXIS)
+                    ret_r = routing.exchange(
+                        {"decbits": pass2_decide(recv_r).reshape(
+                            n_nodes, cap)}, AXIS)
+                    # each lane belongs to exactly one sub-round; the
+                    # others leave its accumulator cell untouched
+                    acc = routing.unpack(ret_r, orig_r, nE,
+                                         {"decbits": acc})["decbits"]
             decb = acc[:nE].reshape(B, R)
             overflow = jnp.zeros(nE, dtype=bool)
             # mesh observatory: one logical request delivery per shipped
@@ -699,9 +747,17 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
                                             measuring)
             stats = obs_mesh.note_owner_rx_counts(
                 stats, rx_live, rx_fin, plugin.epoch_admission, measuring)
-            stats = bump(stats, "exchange_round_cnt",
-                         jnp.max(jnp.where(sd_s < n_nodes, rnd_s + 1, 0)),
-                         measuring)
+            ra = jnp.max(jnp.where(sd_s < n_nodes, rnd_s + 1, 0))
+            stats = bump(stats, "exchange_round_cnt", ra, measuring)
+            # mesh-side round bookkeeping: windows implied by the
+            # delivered per-destination counts (self lane included via
+            # its own count — per_dest excludes it on the split path).
+            # ceil is monotone, so max_d ceil(cnt_d/cap) equals
+            # ceil(max_d cnt_d/cap) and the mesh view lands exactly on
+            # the engine's round_plan count (obs/mesh.py reconcile).
+            stats = obs_mesh.note_round_windows(
+                stats, mesh_per_dest,
+                jnp.sum(local_e.astype(jnp.int32)), cap, measuring)
         else:
             # pack held entries first: dropping a held lock entry would
             # hide it from the owner; a dropped entry aborts its txn
@@ -1098,12 +1154,13 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
             fieldsB_s = {k: v[idxB] for k, v in fieldsB.items()}
             keptB = sdB < n_nodes
 
-            def passB(carry, r):
-                db_c, data_c, tables_c, rxB = carry
-                sendB, _ = routing.pack_round(
+            def shipB_round(r):
+                return routing.pack_round(
                     sdB, posB - r * cap, keptB & (rndB == r), idxB,
-                    n_nodes, cap, fieldsB_s)
-                recvB = routing.exchange(sendB, AXIS)
+                    n_nodes, cap, fieldsB_s)[0]
+
+            def passB_apply(carry, recvB):
+                db_c, data_c, tables_c, rxB = carry
                 rB_key = recvB["key"].reshape(-1)
                 rB_commit = rB_key != NULL_KEY
                 rB_iw = recvB["iw"].reshape(-1) == 1
@@ -1149,7 +1206,7 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
                     notself,
                     jnp.sum(rB_commit.reshape(n_nodes, cap).astype(
                         jnp.int32), axis=1), 0)
-                return (db_c, data_c, tables_c, rxB), jnp.int32(0)
+                return (db_c, data_c, tables_c, rxB)
 
             # Trace-time unroll, NOT lax.scan/fori_loop: when the commit
             # sub-rounds lower to an XLA `while`, the SPMD partitioner
@@ -1164,11 +1221,43 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
             # ceil(nE / cap) stays small (<= part_cnt/rcf, <= 64 at 64
             # nodes) so program size is bounded.
             carryB = (db, data, tables, jnp.zeros(n_nodes, jnp.int32))
-            for _r in range(SB):
-                carryB, _ = passB(carryB, jnp.int32(_r))
+            if pipe:
+                # double buffer: round r+1's pack + all_to_all are
+                # issued before round r's serial db/data/tables apply —
+                # the on_commit scatter chain of one round overlaps the
+                # next round's collective.  The apply order itself is
+                # unchanged, so the serial carry is bit-identical.
+                recv_pendB = routing.exchange(
+                    shipB_round(jnp.int32(0)), AXIS)
+                for _r in range(SB):
+                    recvB = recv_pendB
+                    if _r + 1 < SB:
+                        recv_pendB = routing.exchange(
+                            shipB_round(jnp.int32(_r + 1)), AXIS)
+                    carryB = passB_apply(carryB, recvB)
+            else:
+                for _r in range(SB):
+                    carryB = passB_apply(carryB, routing.exchange(
+                        shipB_round(jnp.int32(_r)), AXIS))
             db, data, tables, rxB_cnt = carryB
             stats = obs_mesh.note_commit_exchange_counts(
                 stats, dest, commit_e & ~local_e, rxB_cnt, measuring)
+            if pipe:
+                # pipeline occupancy over OCCUPIED sub-rounds (rounds
+                # that carried at least one live lane): pass 1 issues ra
+                # forward legs, pass 2 a forward + a return leg per
+                # round, pass B rb commit legs; with the double buffer
+                # every leg after the first of each pass is issued while
+                # another leg of the same pass is still in flight.
+                # pipeline_overlap_frac = pipe_overlap_cnt/pipe_leg_cnt
+                # host-side (bench.py / obs/regress.py).
+                rb = jnp.max(jnp.where(sdB < n_nodes, rndB + 1, 0))
+                legs = 3 * ra + rb
+                lapped = (3 * jnp.maximum(ra - 1, 0)
+                          + jnp.maximum(rb - 1, 0))
+                stats = bump(stats, "pipe_leg_cnt", legs, measuring)
+                stats = bump(stats, "pipe_overlap_cnt", lapped, measuring)
+                stats = obs_trace.record_pipe(stats, t, legs, lapped)
         else:
             sendB, origB, ovfB = routing.pack_by_dest(
                 dest, ts_e, commit_e & ~local_e, n_nodes, cap, fieldsB)
@@ -1804,6 +1893,27 @@ class ShardedEngine:
                        **({"exchange_round_cnt": jnp.zeros((), jnp.int32)}
                           if cfg.exchange_split
                           and self.plugin.never_aborts else {}),
+                       # mesh-side round windows — mirrors
+                       # exchange_round_cnt from the delivered per-dest
+                       # counts so the mesh reconcile can pin the
+                       # identity per node (obs/mesh.py round_windows)
+                       **({"mesh_round_sum": jnp.zeros((), jnp.int32)}
+                          if cfg.mesh and cfg.exchange_split
+                          and self.plugin.never_aborts else {}),
+                       # software-pipeline occupancy: issued exchange
+                       # legs / legs issued with another leg of the same
+                       # pass in flight (Config.pipeline_exchange; the
+                       # overlap fraction is computed host-side)
+                       **({"pipe_leg_cnt": jnp.zeros((), jnp.int32),
+                           "pipe_overlap_cnt": jnp.zeros((), jnp.int32)}
+                          if cfg.pipeline_exchange and cfg.exchange_split
+                          and self.plugin.never_aborts else {}),
+                       # pipeline companion trace ring (legs, overlapped)
+                       **({"arr_pipe_trace":
+                           jnp.zeros((cfg.trace_ticks, 2), jnp.int32)}
+                          if cfg.pipeline_exchange and cfg.exchange_split
+                          and self.plugin.never_aborts
+                          and cfg.trace_ticks > 0 else {}),
                        # remote-grant stickiness counters
                        # (Config.remote_cache): attempts == shipped
                        # (remote_entry_cnt) + suppressed, reconciled in

@@ -16,6 +16,7 @@ Each measurement runs the target in a 200-iteration device-side
 Usage: python experiments/profile_tick.py [B ...]
        python experiments/profile_tick.py --compact [B]   (round-5 ablation)
        python experiments/profile_tick.py --fused [B]     (round-7 ablation)
+       python experiments/profile_tick.py --pipeline [B]  (round-8 ablation)
 """
 
 from __future__ import annotations
@@ -25,6 +26,14 @@ import sys
 import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+if "--pipeline" in sys.argv and "--xla_force_host_platform_device_count" \
+        not in os.environ.get("XLA_FLAGS", ""):
+    # the sharded ablation needs virtual nodes; must land before the
+    # jax import below or the platform is already frozen (bench.py
+    # precedent) — real-chip runs preset their own XLA_FLAGS
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
 
 import jax
 import jax.numpy as jnp
@@ -217,7 +226,59 @@ def fused_ablation(B):
               f"fused={w_on} lax={w_off}", flush=True)
 
 
+def pipeline_ablation(B):
+    """Round-8 ablation: whole-tick ms on the sharded CALVIN split cells
+    with the double-buffered exchange pipeline (Config.pipeline_exchange)
+    on vs off.  The pipeline is bit-identical dataflow, so the whole
+    delta is serialized collective wait the async scheduler recovered;
+    the occupancy columns (sub-rounds/tick and the fraction of legs
+    issued with another leg in flight) say how much overlap the cell
+    exposes structurally."""
+    from deneva_tpu.parallel.sharded import ShardedEngine
+
+    def time_sharded(cfg, iters):
+        eng = ShardedEngine(cfg)
+        st = eng.run_compiled(iters)           # warm + steady occupancy
+        st = eng.run_compiled(iters, st)
+        jax.block_until_ready(st.stats["txn_cnt"])
+        ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            st = eng.run_compiled(iters, st)
+            jax.block_until_ready(st.stats["txn_cnt"])
+            ts.append((time.perf_counter() - t0) / iters * 1e3)
+        return float(np.median(ts)), eng.summary(st)
+
+    iters = 50                                  # 5 windows x 50 sharded ticks
+    base = dict(cc_alg="CALVIN", batch_size=B, synth_table_size=1 << 16,
+                query_pool_size=1 << 12, req_per_query=4, zipf_theta=0.6,
+                tup_read_perc=0.5, warmup_ticks=0, exchange_split=True,
+                route_capacity_factor=0.25)     # low cap -> many sub-rounds
+    nodes = [n for n in (4, 8) if n <= jax.device_count()]
+    print(f"{'cell':>14} {'pipe(ms)':>9} {'serial(ms)':>10} {'x':>5} "
+          f"{'rounds/tick':>11} {'overlap':>8}")
+    for n in nodes:
+        cfg = dict(base, node_cnt=n, part_cnt=n)
+        on_ms, s_on = time_sharded(
+            Config(pipeline_exchange=True, **cfg), iters)
+        off_ms, _ = time_sharded(Config(**cfg), iters)
+        # occupancy from the LAST timed window's psum'd counters: the
+        # summary accumulates across all 5 windows, so normalise by the
+        # total measured ticks
+        # exchange_round_cnt is psum'd over nodes -> per-node mean
+        rounds = (s_on["exchange_round_cnt"]
+                  / max(s_on["measured_ticks"], 1) / n)
+        frac = s_on["pipe_overlap_cnt"] / max(s_on["pipe_leg_cnt"], 1)
+        print(f"{'CALVIN/'+str(n)+'n':>14} {on_ms:>9.3f} {off_ms:>10.3f} "
+              f"{off_ms / on_ms:>5.2f} {rounds:>11.2f} {frac:>8.3f}",
+              flush=True)
+
+
 def main():
+    if "--pipeline" in sys.argv:
+        args = [a for a in sys.argv[1:] if a != "--pipeline"]
+        pipeline_ablation(int(args[0]) if args else 256)
+        return
     if "--fused" in sys.argv:
         args = [a for a in sys.argv[1:] if a != "--fused"]
         fused_ablation(int(args[0]) if args else 8192)

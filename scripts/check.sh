@@ -319,6 +319,63 @@ if [ "$scale_rc" -ne 0 ]; then
     exit "$scale_rc"
 fi
 
+echo "== pipeline smoke (split-exchange overlap parity + reconcile) =="
+# the software-pipelined sharded tick (Config.pipeline_exchange,
+# parallel/sharded.py): (1) the 4-node CALVIN oracle cell must be
+# BIT-identical to the unpipelined split exchange — every summary
+# counter and the data array — adding only the two occupancy counters;
+# (2) the overlap counters must reconcile (0 < overlapped < issued legs
+# on a multi-sub-round cell) and the mesh round-windows identity
+# (mesh_round_sum == exchange_round_cnt) must balance exactly; (3) the
+# sharded certifier must hold the pipelined collective plan clean
+env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+    python - <<'PYEOF'
+import numpy as np
+from deneva_tpu.config import Config
+from deneva_tpu.obs import mesh as obs_mesh
+from deneva_tpu.parallel.sharded import ShardedEngine
+
+KW = dict(cc_alg="CALVIN", node_cnt=4, part_cnt=4, batch_size=32,
+          synth_table_size=1 << 10, query_pool_size=256,
+          req_per_query=4, warmup_ticks=2, exchange_split=True,
+          route_capacity_factor=0.25, mesh=True)
+
+def run(**kw):
+    eng = ShardedEngine(Config(**{**KW, **kw}))
+    st = eng.run(20)
+    return eng, st, eng.summary(st)
+
+_, s0, a = run()
+eng, s1, b = run(pipeline_exchange=True)
+extra = set(b) - set(a)
+assert extra == {"pipe_leg_cnt", "pipe_overlap_cnt"}, extra
+for k in a:
+    assert a[k] == b[k], (k, a[k], b[k])
+assert np.array_equal(np.asarray(s0.data), np.asarray(s1.data)), \
+    "pipelined data array diverged"
+assert 0 < b["pipe_overlap_cnt"] < b["pipe_leg_cnt"], \
+    (b["pipe_overlap_cnt"], b["pipe_leg_cnt"])
+bad = obs_mesh.reconcile(eng.mesh_snapshot(s1), b)
+assert bad == [], f"pipelined mesh failed to reconcile: {bad}"
+assert b["mesh_round_sum"] == b["exchange_round_cnt"] > 0
+frac = b["pipe_overlap_cnt"] / b["pipe_leg_cnt"]
+print(f"[pipeline] CALVIN 4n parity OK: {b['pipe_leg_cnt']} legs, "
+      f"overlap {frac:.2f}, rounds {b['exchange_round_cnt']} balanced")
+PYEOF
+pipe_rc=$?
+if [ "$pipe_rc" -eq 0 ]; then
+    timeout -k 10 300 env JAX_PLATFORMS=cpu \
+        XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+        python -m deneva_tpu.lint.shard_certify --flags pipeline_exchange \
+        --algs CALVIN
+    pipe_rc=$?
+fi
+if [ "$pipe_rc" -ne 0 ]; then
+    echo "pipeline smoke FAILED (parity/reconcile/certify rc=$pipe_rc)"
+    exit "$pipe_rc"
+fi
+
 echo "== adaptive smoke (controller purity + steady compiles) =="
 # the adaptive contention controller (Config.adaptive, deneva_tpu/ctrl/):
 # (1) the DEFAULT tick must carry zero controller state and repeat to an

@@ -142,8 +142,12 @@ def test_split_exchange_reconciles_and_matches_baseline():
                       route_capacity_factor=0.25)
     assert eng.cap < eng_b.cap                # genuinely capacity-bounded
     assert obs_mesh.reconcile(eng.mesh_snapshot(st), s) == []
-    assert set(s) - set(s_base) == {"exchange_round_cnt"}
+    # the mesh-enabled split cell adds its sub-round counter AND the
+    # mesh-side window mirror the round_windows reconcile identity pins
+    assert set(s) - set(s_base) == {"exchange_round_cnt",
+                                    "mesh_round_sum"}
     assert s["exchange_round_cnt"] > 0
+    assert s["mesh_round_sum"] == s["exchange_round_cnt"]
     for k in s_base:
         assert s[k] == s_base[k], (k, s[k], s_base[k])
 

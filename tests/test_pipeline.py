@@ -1,0 +1,167 @@
+"""Pipelined sharded ticks (Config.pipeline_exchange).
+
+The software pipeline is a pure trace-order restructure of the
+epoch-split exchange's unrolled sub-round loops (parallel/sharded.py):
+sub-round k+1's pack + all_to_all are ISSUED before sub-round k's
+received lanes are consumed, so XLA's async collective scheduler can
+overlap the ICI transfer with shard-local compute.  One level down the
+single-chip engine hoists every ``sub_ticks`` round's request plane out
+of the serial grant chain (cc/twopl.py arbitrate_subticked).  Both legs
+are dataflow-identical reorders, so the covering contract is BIT-PARITY:
+
+- the 4-node CALVIN oracle cell must produce the identical [summary]
+  (modulo the two new occupancy counters) and the identical data array;
+- the single-chip sub_ticks kernel must return identical G/W/A masks on
+  every policy (the ``~dead`` request-mask term it drops is provably
+  redundant: arbitrate only aborts request positions, and a txn's sole
+  request lane enters at exactly its own group's round);
+- the flag is trait-gated inert without ``exchange_split`` (and without
+  its never-aborts plugin gate) — zero extra device state;
+- zero steady-state recompiles under the xmeter sentinel, and the mesh
+  round-windows identity (``mesh_round_sum == exchange_round_cnt``)
+  still reconciles exactly on the pipelined path.
+"""
+
+import numpy as np
+import pytest
+
+from deneva_tpu.config import Config
+from deneva_tpu.parallel.sharded import ShardedEngine
+
+# rcf=0.5 keeps the cell multi-sub-round (overlap strictly between 0
+# and the leg count) at half the unrolled trace of the rcf=0.25 smoke
+# in scripts/check.sh — the tier-1 sentinel stays compile-cheap
+BASE = dict(cc_alg="CALVIN", node_cnt=4, part_cnt=4, batch_size=32,
+            synth_table_size=1 << 10, query_pool_size=256,
+            req_per_query=4, warmup_ticks=2, exchange_split=True,
+            route_capacity_factor=0.5)
+
+
+def run_cell(ticks=20, **kw):
+    eng = ShardedEngine(Config(**{**BASE, **kw}))
+    st = eng.run(ticks)
+    return eng, st, eng.summary(st)
+
+
+def test_pipelined_bit_parity_and_mesh_identity_on_oracle_cell():
+    """The 4-node CALVIN oracle cell at a capacity forcing many
+    sub-rounds per epoch, mesh observatory on BOTH sides (one tier-1
+    sentinel, two engine builds): every summary counter and the
+    row-version data array must be bit-identical, the pipelined run
+    adding only its two occupancy counters; and the pipelined path's
+    mesh-side window count must still land exactly on the engine's
+    round_plan bookkeeping — the round_windows reconcile identity
+    (obs/mesh.py) plus every preexisting mesh identity, with zero
+    structural drops."""
+    from deneva_tpu.obs import mesh as obs_mesh
+    _, s0, a = run_cell(mesh=True)
+    eng, s1, b = run_cell(mesh=True, pipeline_exchange=True)
+    assert set(b) - set(a) == {"pipe_leg_cnt", "pipe_overlap_cnt"}
+    for k in a:
+        assert a[k] == b[k], (k, a[k], b[k])
+    assert np.array_equal(np.asarray(s0.data), np.asarray(s1.data))
+    # a multi-sub-round cell must actually overlap: each pass's legs
+    # beyond its first are issued with another leg in flight
+    assert b["pipe_leg_cnt"] > 0
+    assert 0 < b["pipe_overlap_cnt"] < b["pipe_leg_cnt"]
+    snap = eng.mesh_snapshot(s1)
+    assert obs_mesh.reconcile(snap, b) == []
+    assert snap["round_sum"] is not None
+    assert np.array_equal(snap["round_sum"], snap["rounds"])
+    assert b["mesh_round_sum"] == b["exchange_round_cnt"] > 0
+
+
+# tier-2: the certifier already proves the NO_WAIT pipelined cell inert
+# STATICALLY (on-jaxpr == baseline, lint/certify.py) — this runtime
+# double-build re-verifies the summary surface on the slow path only
+@pytest.mark.slow
+def test_abort_capable_plugin_stays_inert():
+    """exchange_split (and therefore the pipeline riding it) is gated
+    on never-aborts plugins: an abort-capable sharded cell with both
+    flags set must carry NO extra device state and produce the
+    bit-identical summary."""
+    _, s0, a = run_cell(cc_alg="NO_WAIT")
+    _, s1, b = run_cell(cc_alg="NO_WAIT", pipeline_exchange=True)
+    assert set(a) == set(b)
+    assert not any(k.startswith("pipe_") for k in b)
+    assert "exchange_round_cnt" not in b
+    for k in a:
+        assert a[k] == b[k], (k, a[k], b[k])
+    assert np.array_equal(np.asarray(s0.data), np.asarray(s1.data))
+
+
+def test_flag_inert_without_exchange_split():
+    """Trait gating: pipeline_exchange without exchange_split adds no
+    stats keys — the sharded leg requires the split path (the on-dict
+    sets both, but a hand-built Config can set the flag alone)."""
+    st = ShardedEngine(Config(**{**BASE, "exchange_split": False,
+                                 "pipeline_exchange": True})).init_state()
+    assert not any(k.startswith("pipe_") for k in st.stats)
+    assert "exchange_round_cnt" not in st.stats
+    assert "mesh_round_sum" not in st.stats
+
+
+def test_subticked_kernel_identity_all_policies():
+    """The single-chip leg's hoist identity, directly on the kernel:
+    pipelined=True must return bit-identical grant/wait/abort masks for
+    every lock policy over randomized txn states."""
+    import jax.numpy as jnp
+    from deneva_tpu.cc import twopl
+    from deneva_tpu.engine.state import TxnState
+    rng = np.random.default_rng(0)
+    B, R, K = 64, 4, 8
+    for policy in ("NO_WAIT", "WAIT_DIE", "CALVIN"):
+        keys = rng.integers(0, 32, (B, R)).astype(np.int32)
+        txn = TxnState(
+            status=jnp.zeros(B, jnp.int32),
+            cursor=jnp.asarray(rng.integers(0, R, B), jnp.int32),
+            ts=jnp.asarray(rng.permutation(B).astype(np.int32) + 1),
+            pool_idx=jnp.zeros(B, jnp.int32),
+            restarts=jnp.zeros(B, jnp.int32),
+            backoff_until=jnp.zeros(B, jnp.int32),
+            start_tick=jnp.zeros(B, jnp.int32),
+            first_start_tick=jnp.zeros(B, jnp.int32),
+            keys=jnp.asarray(keys),
+            is_write=jnp.asarray(rng.random((B, R)) < 0.5),
+            n_req=jnp.full(B, R, jnp.int32),
+            txn_type=jnp.zeros(B, jnp.int32),
+            targs=jnp.zeros((B, 1), jnp.int32),
+            aux=jnp.zeros((B, 1), jnp.int32))
+        active = jnp.asarray(rng.random(B) < 0.8)
+        a = twopl.arbitrate_subticked(txn, active, policy, K)
+        b = twopl.arbitrate_subticked(txn, active, policy, K,
+                                      pipelined=True)
+        for x, y in zip(a, b):
+            assert np.array_equal(np.asarray(x), np.asarray(y)), policy
+
+
+def test_single_chip_subticks_parity_abort_capable():
+    """The single-chip engine's sub_ticks leg with an abort-capable
+    plugin (NO_WAIT): pipelined and in-order schedules must be
+    bit-identical through a full run."""
+    from deneva_tpu.engine.scheduler import Engine
+    kw = dict(cc_alg="NO_WAIT", batch_size=64, synth_table_size=1 << 10,
+              query_pool_size=256, req_per_query=4, warmup_ticks=2,
+              sub_ticks=4)
+    a = Engine(Config(**kw))
+    b = Engine(Config(**kw, pipeline_exchange=True))
+    sa, sb = a.run(30), b.run(30)
+    ra, rb = a.summary(sa), b.summary(sb)
+    assert set(ra) == set(rb)
+    for k in ra:
+        assert ra[k] == rb[k], (k, ra[k], rb[k])
+
+
+# tier-2: the tier-1 sentinels above cover parity + gating; the sentinel
+# run below costs two extra compiled windows
+@pytest.mark.slow
+def test_zero_steady_recompiles_pipelined():
+    """The pipeline is a trace-time restructure — no shape or count
+    depends on data, so the xmeter sentinel must report ZERO post-warm
+    compiles on the pipelined cell."""
+    eng = ShardedEngine(Config(**{**BASE, "pipeline_exchange": True,
+                                  "mesh": True, "xmeter": True}))
+    st = eng.run(12)
+    eng.xmeter.mark_warm()
+    eng.run(12, st)
+    assert eng.xmeter.steady_violations() == []
