@@ -199,6 +199,14 @@ def run_obs(args) -> int:
     Returns a process exit code (non-zero when reconciliation fails or
     the watchdog flags live-lock / spill storms / starved shards)."""
     from deneva_tpu.obs import report as obs_report
+    windows_on = getattr(args, "windows", False)
+    win_kw = {}
+    if windows_on:
+        # size the ring so the run can never wrap (the reconcile would
+        # loudly refuse a lossy ring): one slot per latch cadence
+        wt = max(args.window_ticks, 1)
+        win_kw = dict(windows=True, window_ticks=wt,
+                      window_slots=max(-(-args.ticks // wt), 1))
     cfg = Config(
         cc_alg=args.cc_alg,
         trace_ticks=(args.trace_ticks or args.ticks) if args.trace else 0,
@@ -206,6 +214,7 @@ def run_obs(args) -> int:
         profile=args.profile,
         abort_attribution=True,
         heatmap_bins=256,
+        **win_kw,
         **OBS_KW)
     eng = Engine(cfg)
     t0 = time.perf_counter()
@@ -216,10 +225,27 @@ def run_obs(args) -> int:
 
     code = 0
     artifacts = {}
+    win_snap = extra_rec = None
+    if windows_on:
+        # the window identity is a hard gate like the trace reconcile:
+        # sum of per-window deltas must equal the final cumulative
+        # counters exactly, wrap refused loudly
+        from deneva_tpu.obs import windows as obs_windows
+        win_snap = eng.window_snapshot(state)
+        findings = obs_windows.reconcile(win_snap, summary)
+        print(f"[windows] {obs_windows.n_valid(win_snap)} window(s) of "
+              f"{cfg.window_ticks} tick(s), "
+              f"{len(win_snap['cols_i']) - 1} int + "
+              f"{len(win_snap['cols_f'])} float column(s): "
+              + ("identity OK" if not findings else f"FAIL {findings}"))
+        if findings:
+            code = 1
+        extra_rec = obs_windows.record_extra(cfg, state.stats, state.db)
     if args.trace:
         tr_path = f"{args.out_dir}/trace_{cfg.cc_alg.lower()}.json"
         os.makedirs(args.out_dir, exist_ok=True)
-        obs_trace.to_chrome_trace(state, tr_path, n_ticks=args.ticks)
+        obs_trace.to_chrome_trace(state, tr_path, n_ticks=args.ticks,
+                                  windows=win_snap)
         artifacts["chrome_trace"] = tr_path
         # reconciliation: ring column sums == whole-run [summary] counters
         # (exact: warmup_ticks=0 and the ring accumulates on wrap)
@@ -235,12 +261,16 @@ def run_obs(args) -> int:
                   f"{'OK' if ok else 'MISMATCH'}")
             if not ok:
                 code = 1
-    if args.profile or args.trace:
+    if args.profile or args.trace or windows_on:
+        # windowed runs always leave a record: the "windows" block is
+        # what `python -m deneva_tpu.obs.diff` consumes (two records,
+        # or one record with --windows for the within-run phase diff)
         rec = obs_profiler.run_record(
             cfg, summary,
             phases=eng.profiler.snapshot() if eng.profiler else None,
             timeline=(obs_trace.timeline(state) if args.trace else None),
-            extra={"wall_seconds": wall, "artifacts": artifacts})
+            extra={"wall_seconds": wall, "artifacts": artifacts,
+                   **(extra_rec or {})})
         rec_path = obs_profiler.write_run_record(rec, out_dir=args.out_dir)
         print(f"[obs] run record: {rec_path}")
     if eng.profiler is not None:
@@ -1118,6 +1148,10 @@ def _append_history(doc: dict, cfg: Config, out_dir: str = "results") -> str:
         "unix_time": int(time.time()),
         "commit": _git_commit(),
         "config_fingerprint": obs_profiler.config_fingerprint(cfg),
+        # measurement platform: obs/regress.py gates same-platform
+        # trajectories only (a CPU smoke point must never lower — or
+        # fail — the TPU trajectory's median, the PR 7 pollution bug)
+        "platform": jax.default_backend(),
         "metric": doc["metric"],
         "value": doc["value"],
     }
@@ -1393,6 +1427,21 @@ def _cli():
                         "(Config.fused_arbitrate); the config "
                         "fingerprint keys the history line, so fused "
                         "runs form their own regression trajectory")
+    p.add_argument("--windows", action="store_true",
+                   help="causal-diagnosis window plane (Config.windows) "
+                        "on the observed run: latch the full counter "
+                        "vocabulary every --window-ticks ticks, prove "
+                        "the sum-of-deltas identity, and land the ring "
+                        "in the run record for obs/diff.py; with --diff "
+                        "and ONE record, diff two phases WITHIN it")
+    p.add_argument("--window-ticks", type=int, default=8,
+                   help="latch cadence in ticks (default %(default)s; "
+                        "the ring is sized so the run never wraps)")
+    p.add_argument("--diff", nargs="+", metavar="RECORD",
+                   help="differential run comparator (obs/diff.py): two "
+                        "run-record paths A B — or one with --windows — "
+                        "rank the causes of the change and map each to "
+                        "its config lever; no engine run happens")
     p.add_argument("--no-history", action="store_true",
                    help="skip the bench_history.jsonl trajectory append "
                         "(headline runs only; obs runs never append)")
@@ -1404,6 +1453,12 @@ def _cli():
 
 if __name__ == "__main__":
     _args = _cli()
+    if _args.diff:
+        from deneva_tpu.obs import diff as obs_diff
+        _argv = list(_args.diff)
+        if _args.windows:
+            _argv.append("--windows")
+        raise SystemExit(obs_diff.main(_argv))
     if _args.scaling_grid:
         raise SystemExit(run_scaling_grid(_args, out_dir=_args.out_dir,
                                           history=not _args.no_history))
@@ -1424,7 +1479,8 @@ if __name__ == "__main__":
                                     history=not _args.no_history))
     if _args.xmeter:
         raise SystemExit(run_xmeter(_args))
-    if _args.trace or _args.profile or _args.prog_interval:
+    if _args.trace or _args.profile or _args.prog_interval \
+            or _args.windows:
         raise SystemExit(run_obs(_args))
     if _args.alg:
         run_single_alg(_args.alg, out_dir=_args.out_dir,

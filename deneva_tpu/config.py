@@ -652,6 +652,27 @@ class Config:
     #: serve-loop poll cadence (ticks between exporter snapshots)
     slo_export_interval: int = 10
 
+    #: causal diagnosis observatory, device half (deneva_tpu/obs/
+    #: windows.py): a jit-safe windowed snapshot ring in the donated stats
+    #: carry that latches the FULL cumulative counter vocabulary (commits,
+    #: per-reason aborts, lat_* integrals, queue depth/backlog, ctrl_*
+    #: decisions, remote/reship counts, mesh row sums when enabled) every
+    #: ``window_ticks`` ticks, under the exact identity *sum of window
+    #: deltas == final cumulative counters* (the ring refuses wrap loudly,
+    #: like flight — it never silently drops a window).  Windows make runs
+    #: phase-segmentable: pre/post a hot-set shift, a rate step, a fault,
+    #: or an adaptive gear change, and feed the host-side differential
+    #: comparator (obs/diff.py) and the regress gate's auto-diagnosis.
+    #: Off by default: zero extra device arrays and a byte-identical
+    #: [summary] line (certified).
+    windows: bool = _optin(False, {"windows": True})
+    #: latch cadence (ticks per window); the run length should be a
+    #: multiple so the last window closes exactly on the final counters
+    window_ticks: int = 8
+    #: ring capacity (windows kept); a run latching more than this many
+    #: windows trips the loud wrap refusal in obs/windows.reconcile
+    window_slots: int = 64
+
     # --- run protocol (reference config.h:349-350: 60s warmup + 60s run) ---
     seed: int = 12345
     query_pool_size: int = 1 << 16    # pre-generated queries (client_query.cpp:30)
@@ -747,6 +768,11 @@ class Config:
             assert 0.0 < self.slo_served_floor <= 1.0
             assert 0.0 < self.slo_abort_cap < 1.0
             assert self.slo_export_interval > 0
+        if self.windows:
+            assert self.window_ticks >= 1, \
+                "window_ticks is the latch cadence (ticks per window)"
+            assert self.window_slots >= 1, \
+                "window_slots is the snapshot ring capacity"
         if self.faults:
             assert self.node_cnt > 1, \
                 "faults need a multi-node topology (sharded engine)"

@@ -38,6 +38,7 @@ from deneva_tpu import traffic
 from deneva_tpu.obs import flight as obs_flight
 from deneva_tpu.obs import histo as obs_histo
 from deneva_tpu.obs import trace as obs_trace
+from deneva_tpu.obs import windows as obs_windows
 from deneva_tpu.obs.prog import ProgressEmitter
 from deneva_tpu.obs.profiler import PhaseProfiler
 from deneva_tpu.obs.xmeter import XMeter, ledger_totals, state_ledger
@@ -1001,6 +1002,10 @@ def make_tick(cfg: Config, plugin, pool_dev: dict, workload=None):
                      + dbg.count_violations(cfg, plugin, txn)}
 
         stats = bump(stats, "measured_ticks", 1, measuring)
+        # windowed counter snapshots (obs/windows.py): latch the full
+        # cumulative vocabulary AFTER every bump of this tick, so each
+        # window row is the exact end-of-tick counter state
+        stats = obs_windows.latch(cfg, stats, db, t)
         return EngineState(txn=txn, db=db, data=data, tables=tables,
                            stats=stats, tick=t + 1,
                            pool_cursor=(state.pool_cursor + n_free) % Q,
@@ -1056,14 +1061,20 @@ class Engine:
         from deneva_tpu.config import MODE_NOCC, MODE_NORMAL
         cfg = self.cfg
         B, R = cfg.batch_size, self.pool.max_req
+        db = self.plugin.init_db(cfg, self.n_rows, B, R)
+        stats = _zeros_stats(cfg, wr_ring_shape=(
+            (B, R) if cfg.mode in (MODE_NORMAL, MODE_NOCC) else None),
+            n_families=int(self.pool.txn_type.max()) + 1)
+        # window snapshot plane LAST: its ring widths are the derived
+        # column vocabulary, which must see every other observatory's
+        # scalars (and the db plugin counters) — {} when windows is off
+        stats.update(obs_windows.init_windows(cfg, stats, db))
         return EngineState(
             txn=TxnState.empty(B, R, A=self.pool.args.shape[1]),
-            db=self.plugin.init_db(cfg, self.n_rows, B, R),
+            db=db,
             data=jnp.zeros(self.n_rows, jnp.int32),
             tables=self.workload.init_tables(cfg, 0),
-            stats=_zeros_stats(cfg, wr_ring_shape=(
-                (B, R) if cfg.mode in (MODE_NORMAL, MODE_NOCC) else None),
-                n_families=int(self.pool.txn_type.max()) + 1),
+            stats=stats,
             tick=jnp.zeros((), jnp.int32),
             pool_cursor=jnp.zeros((), jnp.int32),
             ts_counter=jnp.ones((), jnp.int32),
@@ -1183,6 +1194,11 @@ class Engine:
             # famlat these never bias under load (no survivor ring)
             out.update(obs_histo.summary_keys(
                 state.stats["arr_hist_fam"], state.stats["arr_hist_phase"]))
+        if "arr_window_cnt" in state.stats:
+            # window snapshot plane (obs/windows.py): latch count, wrap
+            # verdict and ring geometry — merged only when the plane is
+            # on, like every other opt-in observatory
+            out.update(obs_windows.summary_keys(self.cfg, state.stats))
         if wall_seconds is not None:
             out["tput"] = s["txn_cnt"] / wall_seconds
         if self.xmeter is not None:
@@ -1191,6 +1207,12 @@ class Engine:
             out.update(self.xmeter.summary_fields(
                 hbm_bytes=ledger_totals(self.ledger(state))["total"]))
         return out
+
+    def window_snapshot(self, state: EngineState) -> dict | None:
+        """Host-side window-plane snapshot (obs/windows.py): rings +
+        final counters for deltas/reconcile; None when windows is
+        off."""
+        return obs_windows.snapshot(self.cfg, state.stats, state.db)
 
     def ledger(self, state: EngineState) -> list:
         """Per-array HBM footprint rows (obs/xmeter.py state_ledger):

@@ -1,0 +1,436 @@
+"""Differential run comparator: the host half of causal diagnosis.
+
+``python -m deneva_tpu.obs.diff runA.json runB.json`` takes two run
+records (obs/profiler.py write_run_record) and answers the question
+every hand-derived finding so far had to answer by staring at raw
+counters: *what changed, and which knob moves it*.  It computes an
+exact delta decomposition of the throughput/latency change over the
+identity vocabulary the observatories already reconcile — per-commit
+``lat_*`` phase costs, the abort taxonomy mix, remote amplification
+(the bench scaling grid's ``remote_entry_cnt / (txn_cnt *
+req_per_query)``), queue backlog, error-budget burn, shard imbalance,
+controller escalation churn, exchange occupancy, compile/footprint
+shifts from the xmeter extras — ranks the causes by normalized
+contribution, and maps each ranked cause to the config lever that
+moves it (``remote_cache``, ``compact_auto``, ``fused_arbitrate``,
+``adaptive``, ``exchange_split``, ``pipeline_exchange``).
+
+With ``--windows`` (one record carrying the obs/windows.py snapshot
+plane) the same comparator runs WITHIN a run: the window deltas split
+at ``--split-tick`` (default: midpoint) into two phase summaries —
+pre/post a hot-set shift, a rate step, a fault injection, or an
+adaptive gear change — and the early phase diffs against the late one.
+
+Output: a ``[diagnosis]`` section (also rendered by obs/report.py when
+a report carries one) plus a JSON artifact (``-o``).  The regress gate
+(obs/regress.py) calls :func:`diagnose_entries` on every failure, so
+CI regressions arrive pre-triaged with the same ranked-cause format.
+
+Scoring: each cause is a run-length-normalized rate (per commit, per
+tick, or a share), so A and B compare across different run lengths;
+the score is ``|b - a| / (|a| + |b| + tau)`` with a per-cause noise
+floor ``tau`` — a relative-change measure in [0, 1) that ranks a
+0 -> 8.4 amplification blow-up above a 0.98 -> 0.99 imbalance wiggle.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+#: per-cause noise floors (tau): the magnitude below which a relative
+#: change is treated as noise rather than signal
+TAU_SHARE = 0.05      # shares / rates in [0, 1]
+TAU_TICKS = 2.0       # per-commit tick costs
+TAU_RATIO = 0.25      # open-ended ratios (amplification, burn)
+
+
+def _g(s: dict, k: str, default: float = 0.0) -> float:
+    try:
+        return float(s.get(k, default))
+    except (TypeError, ValueError):
+        return default
+
+
+def _per_commit(s: dict, k: str) -> float:
+    return _g(s, k) / max(_g(s, "txn_cnt"), 1.0)
+
+
+def _per_tick(s: dict, k: str) -> float:
+    return _g(s, k) / max(_g(s, "measured_ticks"), 1.0)
+
+
+def _outcomes(s: dict) -> float:
+    return max(_g(s, "txn_cnt") + _g(s, "total_txn_abort_cnt"), 1.0)
+
+
+def amplification(s: dict, cfg: dict) -> float:
+    """Remote entries shipped per requested access — bench.py's scaling
+    grid ``remote_ratio`` (gated inverted by obs/regress.py)."""
+    req = float(cfg.get("req_per_query", 1) or 1)
+    return _g(s, "remote_entry_cnt") / max(_g(s, "txn_cnt") * req, 1.0)
+
+
+def _reason_lever(name: str) -> tuple:
+    """Config lever for one abort-taxonomy reason, by reason family."""
+    if "compact" in name or "spill" in name:
+        return ("compact_auto", "compaction pressure: widen the lane "
+                "budget or pin compact_lanes")
+    if "route" in name or "overflow" in name:
+        return ("exchange_split", "exchange lane overflow: split the "
+                "exchange into capacity-bounded sub-rounds")
+    return ("adaptive", "conflict churn: let the controller tune "
+            "backoff / escalate the hot keys")
+
+
+#: static cause registry: (name, lever, description, extractor, tau,
+#: higher_is_better).  Extractors see (summary, config_dict); a cause
+#: joins a diff only when one of its keys is present in either summary
+#: (key, tested via the probe field).
+_CAUSES = (
+    ("lat_process_per_commit", "fused_arbitrate",
+     "execute-phase compute per commit (sort/arbitration bound: fuse "
+     "the VMEM kernel, or compact the entry lanes)",
+     lambda s, c: _per_commit(s, "lat_process_time"),
+     "lat_process_time", TAU_TICKS, False),
+    ("lat_cc_block_per_commit", "adaptive",
+     "lock-wait ticks per commit (contention stall: controller backoff "
+     "/ escalation tuning)",
+     lambda s, c: _per_commit(s, "lat_cc_block_time"),
+     "lat_cc_block_time", TAU_TICKS, False),
+    ("lat_abort_backoff_per_commit", "adaptive",
+     "abort-backoff ticks per commit (restart churn: controller "
+     "backoff tuning)",
+     lambda s, c: _per_commit(s, "lat_abort_time"),
+     "lat_abort_time", TAU_TICKS, False),
+    ("lat_network_per_commit", "remote_cache",
+     "remote-shipment ticks per commit (coordination cost: cache "
+     "remote grants to suppress re-ships)",
+     lambda s, c: _per_commit(s, "lat_network_time"),
+     "lat_network_time", TAU_TICKS, False),
+    ("abort_rate", "adaptive",
+     "aborts per outcome (wasted work share)",
+     lambda s, c: _g(s, "total_txn_abort_cnt") / _outcomes(s),
+     "total_txn_abort_cnt", TAU_SHARE, False),
+    ("remote_amplification", "remote_cache",
+     "remote entries shipped per requested access (the PR 9 flat-MAAT "
+     "cause: restart-driven re-shipment — cache remote grants)",
+     amplification, "remote_entry_cnt", TAU_RATIO, False),
+    ("reship_suppression", "remote_cache",
+     "re-ships suppressed per remote attempt (cache effectiveness)",
+     lambda s, c: _g(s, "reship_suppressed_cnt")
+     / max(_g(s, "remote_attempt_cnt"), 1.0),
+     "&remote_attempt_cnt", TAU_SHARE, True),
+    ("queue_backlog_per_tick", "adaptive",
+     "admission backlog left per measured tick (offered load above the "
+     "service knee)",
+     lambda s, c: _per_tick(s, "queue_len"),
+     "queue_len", TAU_RATIO, False),
+    ("burn_fast", "adaptive",
+     "fast-window error-budget burn rate (SLO pressure)",
+     lambda s, c: _g(s, "burn_fast"), "burn_fast", TAU_RATIO, False),
+    ("imbalance", "exchange_split",
+     "1 - Jain fairness over per-node commit loads (shard skew); the "
+     "wide noise floor keeps a 0.98 -> 0.99 Jain wiggle out of the "
+     "ranking",
+     lambda s, c: 1.0 - _g(s, "imb_jain", 1.0),
+     "imb_jain", TAU_RATIO, False),
+    ("straggler_per_tick", "pipeline_exchange",
+     "straggler ticks per measured tick (nodes idling on the slowest "
+     "exchange leg: overlap the sub-rounds)",
+     lambda s, c: _per_tick(s, "straggler_tick_cnt"),
+     "straggler_tick_cnt", TAU_SHARE, False),
+    ("ctrl_escalations_per_commit", "adaptive",
+     "hot-key escalations per commit (the PR 13 hot-cell cause: "
+     "saturated-hot-set escalation serializing the batch)",
+     lambda s, c: _per_commit(s, "ctrl_escalate_cnt"),
+     "ctrl_escalate_cnt", TAU_SHARE, False),
+    ("ctrl_gate_stalls_per_commit", "adaptive",
+     "serialization-gate stalls per commit (escalated keys queueing "
+     "behind the gate)",
+     lambda s, c: _per_commit(s, "ctrl_esc_block_cnt"),
+     "ctrl_esc_block_cnt", TAU_SHARE, False),
+    ("exchange_rounds_per_tick", "exchange_split",
+     "occupied exchange sub-rounds per measured tick (split-exchange "
+     "serialization depth)",
+     lambda s, c: _per_tick(s, "exchange_round_cnt"),
+     "exchange_round_cnt", TAU_RATIO, False),
+    ("pipeline_overlap_frac", "pipeline_exchange",
+     "overlapped exchange legs per issued leg (software-pipeline "
+     "occupancy — higher is better)",
+     lambda s, c: _g(s, "pipe_overlap_cnt")
+     / max(_g(s, "pipe_leg_cnt"), 1.0),
+     "&pipe_leg_cnt", TAU_SHARE, True),
+    ("compile_cnt", "fused_arbitrate",
+     "XLA compiles over the run (xmeter: recompile churn eats "
+     "wall-clock, not schedule ticks)",
+     lambda s, c: _g(s, "compile_cnt"), "compile_cnt", TAU_RATIO, False),
+    ("hbm_gib", "compact_auto",
+     "resident HBM footprint, GiB (xmeter ledger: compact the entry "
+     "lanes to shrink the carry)",
+     lambda s, c: _g(s, "hbm_bytes") / 2**30,
+     "hbm_bytes", TAU_SHARE, False),
+)
+
+
+def _score(a: float, b: float, tau: float) -> float:
+    return abs(b - a) / (abs(a) + abs(b) + tau)
+
+
+def diff_summaries(sa: dict, sb: dict, cfg_a: dict | None = None,
+                   cfg_b: dict | None = None,
+                   label_a: str = "A", label_b: str = "B") -> dict:
+    """The diagnosis dict: outcome deltas + causes ranked by score.
+    A cause rides only when its probe key is present in either summary
+    (an absent plane reads as 0 on the side missing it)."""
+    cfg_a, cfg_b = cfg_a or {}, cfg_b or {}
+    tput_a = _g(sa, "txn_cnt") / max(_g(sa, "measured_ticks"), 1.0)
+    tput_b = _g(sb, "txn_cnt") / max(_g(sb, "measured_ticks"), 1.0)
+    lat_a = _g(sa, "txn_total_time_ticks") / max(_g(sa, "txn_cnt"), 1.0)
+    lat_b = _g(sb, "txn_total_time_ticks") / max(_g(sb, "txn_cnt"), 1.0)
+    causes = []
+
+    def add(name, lever, desc, va, vb, tau, good):
+        sc = _score(va, vb, tau)
+        worse = (vb < va) if good else (vb > va)
+        causes.append({"cause": name, "lever": lever, "desc": desc,
+                       "a": va, "b": vb, "delta": vb - va,
+                       "score": sc, "regressing": bool(worse and sc > 0)})
+
+    for name, lever, desc, fn, probe, tau, good in _CAUSES:
+        if probe.startswith("&"):
+            # effectiveness ratios of an opt-in mechanism (suppression,
+            # overlap) join only when BOTH runs carry the plane — when
+            # one side lacks the mechanism, "effectiveness fell to 0"
+            # merely restates the config delta and would mask the
+            # behavioral cause (e.g. amplification) behind it
+            if probe[1:] not in sa or probe[1:] not in sb:
+                continue
+        elif probe not in sa and probe not in sb:
+            continue
+        add(name, lever, desc, fn(sa, cfg_a), fn(sb, cfg_b), tau, good)
+    # dynamic per-reason abort-taxonomy causes (cc/base.py registry keys
+    # present on attributed runs), as shares of all outcomes
+    reasons = sorted({k for k in (*sa, *sb)
+                      if k.startswith("abort_") and k.endswith("_cnt")})
+    for k in reasons:
+        name = k[len("abort_"):-len("_cnt")]
+        lever, why = _reason_lever(name)
+        add(f"abort_mix[{name}]", lever,
+            f"'{name}' aborts per outcome ({why})",
+            _g(sa, k) / _outcomes(sa), _g(sb, k) / _outcomes(sb),
+            TAU_SHARE, False)
+    causes.sort(key=lambda c: -c["score"])
+    ranked = [c for c in causes if c["score"] > 0.0]
+    return {"kind": "run_diff", "a": label_a, "b": label_b,
+            "tput_a": tput_a, "tput_b": tput_b,
+            "tput_ratio": tput_b / max(tput_a, 1e-9),
+            "latency_a": lat_a, "latency_b": lat_b,
+            "causes": ranked,
+            "top_cause": ranked[0]["cause"] if ranked else None,
+            "top_lever": ranked[0]["lever"] if ranked else None}
+
+
+def diff_records(rec_a: dict, rec_b: dict,
+                 label_a: str = "A", label_b: str = "B") -> dict:
+    """Diff two run-record JSON documents (obs/profiler.py)."""
+    return diff_summaries(rec_a["summary"], rec_b["summary"],
+                          rec_a.get("config"), rec_b.get("config"),
+                          label_a, label_b)
+
+
+# ---------------------------------------------------------------------------
+# window-vs-window: one record, two phases
+# ---------------------------------------------------------------------------
+
+def segment_summaries(rec: dict, split_tick: int | None = None) -> tuple:
+    """Split a record's obs/windows.py snapshot plane into two phase
+    summaries: counter deltas summed over the windows at or before
+    ``split_tick`` (default: the midpoint window) vs the rest, plus a
+    per-phase ``measured_ticks`` so every per-tick/per-commit cause
+    normalizes within its own phase.  The split is EXACT: the two
+    pseudo-summaries add back to the run's cumulative counters (the
+    window identity)."""
+    win = rec.get("windows")
+    if not win:
+        raise ValueError("record carries no windows block "
+                         "(run with Config.windows)")
+    if win.get("wrapped"):
+        raise ValueError(
+            f"window ring wrapped ({win['cnt']} windows latched, "
+            f"{win['slots']} kept) — refusing to segment a lossy ring")
+    ring_i = np.asarray(win["ring_i"], np.int64)
+    ring_f = np.asarray(win["ring_f"], np.float64)
+    ticks = ring_i[:, win["cols_i"].index("tick")]
+    if split_tick is None:
+        split_tick = int(ticks[max(len(ticks) // 2 - 1, 0)])
+    early = ticks <= split_tick
+    if not early.any() or early.all():
+        raise ValueError(f"split tick {split_tick} leaves an empty "
+                         f"phase (windows end at {ticks.tolist()})")
+
+    def phase(mask):
+        d_i = np.diff(ring_i, axis=0,
+                      prepend=np.zeros((1, ring_i.shape[1]), np.int64))
+        d_f = np.diff(ring_f, axis=0,
+                      prepend=np.zeros((1, ring_f.shape[1]), np.float64))
+        s = {k: int(v) for k, v in
+             zip(win["cols_i"], d_i[mask].sum(axis=0)) if k != "tick"}
+        s.update({k: float(v) for k, v in
+                  zip(win["cols_f"], d_f[mask].sum(axis=0))})
+        return s
+
+    return phase(early), phase(~early), int(split_tick)
+
+
+def diff_windows(rec: dict, split_tick: int | None = None) -> dict:
+    """Window-vs-window diagnosis within one record: early phase is the
+    baseline, late phase the comparison."""
+    sa, sb, split = segment_summaries(rec, split_tick)
+    cfg = rec.get("config")
+    out = diff_summaries(sa, sb, cfg, cfg,
+                         label_a=f"ticks<={split}",
+                         label_b=f"ticks>{split}")
+    out["kind"] = "window_diff"
+    out["split_tick"] = split
+    return out
+
+
+# ---------------------------------------------------------------------------
+# regress-gate triage: failing trajectory point vs its median prior
+# ---------------------------------------------------------------------------
+
+#: ride-along families an obs/regress.py trajectory entry carries, with
+#: the lever the family's regression maps to and whether higher is
+#: better (mirrors the gate's floor/ceiling orientation)
+_ENTRY_FAMILIES = (
+    ("algs", "commits_per_tick", "fused_arbitrate", True),
+    ("knees", "offered_load_knee", "adaptive", True),
+    ("scaling_grid", "efficiency", "exchange_split", True),
+    ("scaling_amp", "amplification", "remote_cache", False),
+    ("pipeline_overlap", "pipeline_overlap_frac",
+     "pipeline_exchange", True),
+    ("adaptive_vs_static", "adaptive_vs_static", "adaptive", True),
+    ("slo_p99", "slo_p99", "adaptive", False),
+)
+
+
+def diagnose_entries(current: dict, prior: list[dict]) -> dict:
+    """Triage one failing trajectory point against the median of its
+    priors: every ride-along cell the point carries is scored against
+    the per-key median over the priors that also carry it, ranked by
+    the same relative-change score as the run diff.  This is what the
+    regress gate attaches to its failures — the regression arrives
+    naming the cell, the direction and the lever."""
+    causes = []
+    fams = [("value", f"headline[{current.get('metric')}]",
+             "fused_arbitrate", True)]
+    for fam, metric, lever, good in _ENTRY_FAMILIES:
+        for key in sorted(current.get(fam, {}) or {}):
+            fams.append((f"{fam}.{key}", f"{metric}[{key}]", lever, good))
+    for path, name, lever, good in fams:
+        fam, _, key = path.partition(".")
+        cur = (current.get("value") if fam == "value"
+               else current.get(fam, {}).get(key))
+        if cur is None:
+            continue
+        base = [e.get("value") if fam == "value"
+                else e.get(fam, {}).get(key) for e in prior]
+        base = [v for v in base if v is not None]
+        if not base:
+            continue
+        med = float(np.median(base))
+        sc = _score(med, float(cur), TAU_RATIO)
+        worse = (cur < med) if good else (cur > med)
+        causes.append({"cause": name, "lever": lever,
+                       "desc": f"trajectory cell vs median of "
+                               f"{len(base)} prior point(s)",
+                       "a": med, "b": float(cur), "delta": float(cur) - med,
+                       "score": sc, "regressing": bool(worse and sc > 0)})
+    causes.sort(key=lambda c: (-c["regressing"], -c["score"]))
+    ranked = [c for c in causes if c["score"] > 0.0]
+    top = next((c for c in ranked if c["regressing"]),
+               ranked[0] if ranked else None)
+    return {"kind": "regress_diff",
+            "a": "median(prior)", "b": current.get("source", "current"),
+            "causes": ranked,
+            "top_cause": top["cause"] if top else None,
+            "top_lever": top["lever"] if top else None}
+
+
+# ---------------------------------------------------------------------------
+# rendering
+# ---------------------------------------------------------------------------
+
+def render_diagnosis(diag: dict, topk: int = 8) -> str:
+    """The ``[diagnosis]`` section (obs/report.py render_text emits the
+    same lines when a report carries a diagnosis)."""
+    lines = []
+    if "tput_a" in diag:
+        lines.append(
+            f"[diagnosis] {diag['a']} -> {diag['b']}: throughput "
+            f"{diag['tput_a']:.2f} -> {diag['tput_b']:.2f} commits/tick "
+            f"({diag['tput_ratio']:.2f}x), latency "
+            f"{diag['latency_a']:.1f} -> {diag['latency_b']:.1f} ticks")
+    else:
+        lines.append(f"[diagnosis] {diag['b']} vs {diag['a']}")
+    if not diag["causes"]:
+        lines.append("  (no cause moved above its noise floor)")
+    for i, c in enumerate(diag["causes"][:topk]):
+        tag = "REGRESSING" if c["regressing"] else "shifted  "
+        lines.append(
+            f"  {i + 1}. {tag} {c['cause']:<34} "
+            f"{c['a']:>10.4g} -> {c['b']:<10.4g} "
+            f"score {c['score']:.2f}  lever: {c['lever']}")
+        lines.append(f"     {c['desc']}")
+    if diag.get("top_cause"):
+        lines.append(f"  verdict: {diag['top_cause']} "
+                     f"(try Config.{diag['top_lever']})")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    import argparse
+    p = argparse.ArgumentParser(
+        prog="python -m deneva_tpu.obs.diff",
+        description="differential run comparator: rank the causes of a "
+                    "throughput/latency change and map each to its "
+                    "config lever")
+    p.add_argument("records", nargs="+",
+                   help="two run-record JSON paths (A B), or one record "
+                        "with --windows")
+    p.add_argument("--windows", action="store_true",
+                   help="diff two phases WITHIN one record's window "
+                        "plane (Config.windows)")
+    p.add_argument("--split-tick", type=int, default=None,
+                   help="window-mode phase boundary (default: midpoint)")
+    p.add_argument("-o", "--out", default=None,
+                   help="also write the diagnosis JSON artifact here")
+    p.add_argument("--json", action="store_true",
+                   help="print the JSON diagnosis instead of text")
+    args = p.parse_args(argv)
+
+    recs = []
+    for path in args.records:
+        with open(path) as f:
+            recs.append(json.load(f))
+    if args.windows:
+        if len(recs) != 1:
+            p.error("--windows takes exactly one record")
+        diag = diff_windows(recs[0], args.split_tick)
+    else:
+        if len(recs) != 2:
+            p.error("run diff takes exactly two records (A B)")
+        diag = diff_records(recs[0], recs[1],
+                            label_a=args.records[0],
+                            label_b=args.records[1])
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(diag, f)
+    print(json.dumps(diag) if args.json else render_diagnosis(diag))
+    return 0
+
+
+if __name__ == "__main__":          # pragma: no cover - CLI shim
+    raise SystemExit(main())

@@ -117,6 +117,24 @@ def record_events(rec: dict, pid_base: int = 0, tick_us: float = 1.0,
             ev = dict(ev)
             ev["pid"] = pid_base + ev["pid"]
             events.append(ev)
+    win = rec.get("windows")
+    if win and not win.get("wrapped"):
+        # window-delta counter track (obs/trace.py's conditional 11th
+        # track), rebuilt from the record's obs/windows.py block: one
+        # cluster-wide counter per snapshot column stepping by that
+        # window's delta at its boundary tick.  A wrapped ring is
+        # skipped — lossy deltas would draw a lie.
+        cols = list(win["cols_i"])
+        ti = cols.index("tick")
+        prev = [0] * len(cols)
+        for row in win["ring_i"]:
+            events.append({"name": "window deltas", "ph": "C",
+                           "ts": float(row[ti]) * tick_us,
+                           "pid": pid_base,
+                           "args": {c: int(row[j]) - int(prev[j])
+                                    for j, c in enumerate(cols)
+                                    if j != ti}})
+            prev = row
     return events
 
 

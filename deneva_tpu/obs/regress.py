@@ -42,10 +42,23 @@ INVERTED — the latency tail GROWING past (1 + tol) x median under the
 same flash-crowd schedule is the regression the SLO plane exists to
 catch.
 
+Every point records the ``platform`` it was measured on (bench.py tags
+``jax.default_backend()``), and the gate compares same-platform
+trajectories ONLY: a CPU smoke point never gates against TPU history or
+vice versa — the structural fix for the PR 7 one-off repair of the
+CPU-polluted TPU trajectory.  Legacy untagged points (recorded before
+the tag existed) stay in every comparison, so old history keeps
+protecting until the trajectory is re-measured.
+
 A gate with no prior data (e.g. per-alg cells first appeared in round 5)
 is SKIPPED with a note, not failed — the gate self-arms as history
 accumulates.  Exit code = number of regressions (0 == clean), wired
-into scripts/check.sh after the bench smoke.
+into scripts/check.sh after the bench smoke.  When the gate FAILS it
+auto-attaches a causal diagnosis (obs/diff.py diagnose_entries): the
+failing point vs the median of its priors, every ride-along cell ranked
+by relative change and mapped to its config lever, printed as a
+``[diagnosis]`` section and written next to the history file as
+``diagnosis_regress.json`` — the regression arrives pre-triaged.
 """
 
 from __future__ import annotations
@@ -100,7 +113,11 @@ def _entry(source: str, order: tuple, doc: dict) -> Optional[dict]:
         if c is not None:
             algs[alg] = c
     out = {"source": source, "order": order, "metric": metric,
-           "value": value, "algs": algs}
+           "value": value, "algs": algs,
+           # measurement platform (bench.py tags jax.default_backend());
+           # None on legacy points recorded before the tag existed —
+           # those gate everywhere, tagged points gate same-platform only
+           "platform": doc.get("platform")}
     # open-system sweep records (bench.py --offered-load) carry the rate
     # grid and the per-algorithm saturation knee; older records without
     # them normalize to an empty dict, so mixed trajectories keep
@@ -244,6 +261,15 @@ def gate(entries: list[dict], current: Optional[dict] = None,
                     "skipped": ["empty trajectory: nothing to gate"]}
         current = entries[-1]
     prior = [e for e in entries if e is not current]
+    # same-platform trajectories only: a point tagged with a platform
+    # gates against priors on that platform (plus legacy untagged
+    # points); an untagged current keeps the whole trajectory.  This is
+    # the structural form of the PR 7 repair — a CPU smoke run can no
+    # longer fail (or silently lower) the TPU trajectory's median
+    plat = current.get("platform")
+    if plat is not None:
+        prior = [e for e in prior
+                 if e.get("platform") in (None, plat)]
     checks, failures, skipped = [], [], []
 
     def check(name: str, cur: float, baseline: list[float], tol: float):
@@ -365,8 +391,15 @@ def gate(entries: list[dict], current: Optional[dict] = None,
                       [e["slo_p99"][cell_key] for e in prior
                        if cell_key in e.get("slo_p99", {})],
                       cpt_tolerance)
-    return {"current": current, "checks": checks, "failures": failures,
-            "skipped": skipped}
+    result = {"current": current, "checks": checks, "failures": failures,
+              "skipped": skipped}
+    if failures:
+        # a failing gate ships pre-triaged: rank every ride-along cell
+        # of the failing point against the median of the same priors the
+        # checks used, mapped to config levers (obs/diff.py)
+        from deneva_tpu.obs import diff as obs_diff
+        result["diagnosis"] = obs_diff.diagnose_entries(current, prior)
+    return result
 
 
 def render_text(result: dict) -> str:
@@ -390,6 +423,9 @@ def render_text(result: dict) -> str:
         lines.append(f"  skip {s}")
     n = len(result["failures"])
     lines.append(f"[regress] {n} regression(s)")
+    if result.get("diagnosis"):
+        from deneva_tpu.obs import diff as obs_diff
+        lines.append(obs_diff.render_diagnosis(result["diagnosis"]))
     return "\n".join(lines)
 
 
@@ -429,6 +465,14 @@ def main(argv=None) -> int:
                    if e["source"] != current["source"]] + [current]
     result = gate(entries, current=current, tolerance=args.tolerance,
                   cpt_tolerance=args.cpt_tolerance)
+    if result.get("diagnosis"):
+        # the failure artifact lands next to the history file (first
+        # directory argument), so CI archives the triage with the gate
+        out_dir = next((p for p in args.paths if os.path.isdir(p)), ".")
+        art = os.path.join(out_dir, "diagnosis_regress.json")
+        with open(art, "w") as f:
+            json.dump(result["diagnosis"], f)
+        print(f"[regress] diagnosis artifact: {art}")
     if args.json:
         print(json.dumps(result))
     else:

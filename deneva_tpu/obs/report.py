@@ -243,7 +243,8 @@ def build_report(summary: dict, timeline: dict | None = None,
                  stats: dict | None = None, topk: int = 8,
                  xmeter: dict | None = None,
                  flight: dict | None = None,
-                 mesh: dict | None = None) -> dict:
+                 mesh: dict | None = None,
+                 diagnosis: dict | None = None) -> dict:
     """The machine-readable waterfall: phases (slot-ticks + share),
     throughput, the abort taxonomy, hot keys / per-partition conflicts /
     wait-depth histogram (when the run kept a heatmap), reconciliation
@@ -300,6 +301,11 @@ def build_report(summary: dict, timeline: dict | None = None,
         # run record's "mesh" field) — per-node-pair traffic volumes,
         # type breakdown, load planes and the imbalance block
         rep["mesh"] = mesh
+    if diagnosis is not None:
+        # the [diagnosis] section: pass an obs/diff.py diagnosis dict
+        # (run diff, window-vs-window diff, or a regress-gate triage) —
+        # ranked causes with their config levers ride the report
+        rep["diagnosis"] = diagnosis
     ctrl = _ctrl_section(summary)
     if ctrl is not None:
         rep["ctrl"] = ctrl
@@ -589,6 +595,9 @@ def render_text(rep: dict) -> str:
                 f"slow={sl['burn_slow']:.2f}x  served={sl['served_frac']:.3f}"
                 f"  abort_rate={sl['abort_rate']:.3f}  alert={state} "
                 f"({sl['alerts']} fired, {sl['breach_ticks']} breach ticks)")
+    if rep.get("diagnosis") is not None:
+        from deneva_tpu.obs.diff import render_diagnosis
+        lines.append(render_diagnosis(rep["diagnosis"]))
     for flag, msg in rep["watchdog"]["findings"]:
         lines.append(f"[watchdog] {flag}: {msg}")
     if not rep["watchdog"]["findings"]:
@@ -602,7 +611,8 @@ def report_from_record(rec: dict) -> dict:
     return build_report(rec["summary"], rec.get("timeline"),
                         xmeter=rec.get("xmeter"),
                         flight=rec.get("flight"),
-                        mesh=rec.get("mesh"))
+                        mesh=rec.get("mesh"),
+                        diagnosis=rec.get("diagnosis"))
 
 
 def main(argv=None) -> int:

@@ -382,7 +382,8 @@ def totals(state_or_stats) -> dict:
 def to_chrome_trace(state_or_stats, path: str, n_ticks: int | None = None,
                     tick_us: float = 1.0,
                     xmeter: dict | None = None,
-                    flight: dict | None = None) -> str:
+                    flight: dict | None = None,
+                    windows: dict | None = None) -> str:
     """Export the timeline as Chrome trace-event JSON (the JSON Array
     Format with counter events, loadable at ui.perfetto.dev).
 
@@ -395,7 +396,13 @@ def to_chrome_trace(state_or_stats, path: str, n_ticks: int | None = None,
     indexed by call number on the same timebase.  ``flight`` (an
     obs/flight.py ``snapshot()``) adds the per-txn SPAN track beside the
     counter tracks: one duration slice per sampled txn lifecycle with
-    nested per-attempt child slices and abort-reason flow arrows."""
+    nested per-attempt child slices and abort-reason flow arrows.
+    ``windows`` (an obs/windows.py ``snapshot()`` or a run record's
+    ``"windows"`` block) adds the 11th counter track, "window deltas":
+    one cluster-wide counter per snapshot column, stepping at each
+    window boundary by that window's delta — the coarse causal view
+    (which phase of the run moved which counter) beside the per-tick
+    rows, derived host-side so the device plane stays two rings."""
     a = _buffer(state_or_stats)
     shards = a[None] if a.ndim == 2 else a          # (N, T, K)
     rbuf = _reason_buffer(state_or_stats)
@@ -519,6 +526,29 @@ def to_chrome_trace(state_or_stats, path: str, n_ticks: int | None = None,
                 events.append({"name": "kernel ms", "ph": "C",
                                "ts": float(i) * tick_us, "pid": 0,
                                "args": {name: float(ms)}})
+    wcols = []
+    if windows:
+        # 11th counter track (same conditional discipline): per-window
+        # counter DELTAS at the window-boundary ticks, host-derived from
+        # the obs/windows.py keep-last ring (snapshot dict or the JSON
+        # "windows" record block — both carry cols_i/ring_i/cnt/slots).
+        # A wrapped ring is skipped, not guessed at: lossy deltas would
+        # draw a lie.
+        wring = np.asarray(windows["ring_i"], np.int64)
+        wv = min(int(windows["cnt"]), int(windows["slots"]),
+                 wring.shape[0])
+        if int(windows["cnt"]) <= int(windows["slots"]) and wv > 0:
+            cols = list(windows["cols_i"])
+            ti = cols.index("tick")
+            wd = np.diff(wring[:wv], axis=0,
+                         prepend=np.zeros((1, wring.shape[1]), np.int64))
+            wcols = [c for c in cols if c != "tick"]
+            for w in range(wv):
+                events.append(
+                    {"name": "window deltas", "ph": "C",
+                     "ts": float(wring[w, ti]) * tick_us, "pid": 0,
+                     "args": {c: int(wd[w, j])
+                              for j, c in enumerate(cols) if j != ti}})
     n_spans = 0
     if flight:
         # per-txn span track (same conditional discipline as the other
@@ -544,6 +574,8 @@ def to_chrome_trace(state_or_stats, path: str, n_ticks: int | None = None,
         doc["metadata"]["slo_track"] = list(_slo_names(sshards.shape[-1]))
     if pshards is not None:
         doc["metadata"]["pipe_track"] = list(PIPE_COLUMNS)
+    if wcols:
+        doc["metadata"]["window_track"] = wcols
     if xentries:
         doc["metadata"]["xmeter_entries"] = xentries
     if flight:

@@ -1,0 +1,309 @@
+"""Windowed counter snapshots: the device half of causal diagnosis.
+
+Every published finding so far (the PR 9 "flat MAAT scaling is remote
+amplification, not imbalance"; the PR 13 "adaptive collapses on HOT
+cells") was hand-derived from END-of-run counters — one cumulative
+number per run, no way to see WHEN inside a run the behavior changed.
+This module makes runs phase-segmentable: every ``Config.window_ticks``
+ticks the tick function latches the FULL cumulative counter vocabulary
+(the engine aggregates, the per-reason abort taxonomy, the ``lat_*``
+integrals, queue depth/backlog, ``ctrl_*`` decisions, remote/reship
+counts, the mesh row sum when that plane rides) into a keep-last
+snapshot ring in the donated stats carry.  Host-side consumers
+(:mod:`deneva_tpu.obs.diff`, the Perfetto export) difference adjacent
+snapshots into per-window deltas — pre/post a hot-set shift, a rate
+step, a fault injection, or an adaptive gear change.
+
+The plane is self-verifying under the exact identity
+
+    sum of window deltas == final cumulative counters
+
+which holds bit-exactly for the int32 columns (telescoping int sums)
+and requires the LAST snapshot to equal the final carry for the float32
+columns (:func:`reconcile` checks both, plus the tick stamps that pin
+each row to its window).  A run that latches more windows than the ring
+holds is REFUSED loudly (a ``window_ring_wrapped`` finding, like the
+flight recorder's span ring) — a wrapped ring can no longer prove the
+identity, and silently passing would be a lie.
+
+Column vocabulary: derived, not declared.  :func:`columns` scrapes the
+same stats/db dicts the [summary] scrape reads — every 0-d non-``arr_``
+int32/float32 stats scalar (minus ``wr_ring_cursor``, which the write-
+buffer flush resets), every 0-d db ``_cnt`` plugin counter, a leading
+``tick`` stamp, and a derived ``mesh_tx_total`` row sum when the mesh
+plane is carried — so new counters join the window vocabulary the tick
+they are added, with no second registry to drift.
+
+Sharded runs carry one ring per node (the tick body under shard_map
+sees single-node shapes, so the SAME latch serves both engines); the
+node-stacked ``(N, S, K)`` int rings merge EXACTLY by elementwise add
+— :meth:`ShardedEngine.window_cluster_plane` proves the device psum
+bit-equal to the host sum, the obs/histo.py pattern.
+
+Off path (``Config.windows`` false, the default) this module
+contributes zero carried arrays and zero summary keys — the certifier
+holds the flag byte-identical like every other ``_optin`` observatory.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+#: stats scalars excluded from the window vocabulary: non-cumulative
+#: bookkeeping the run protocol resets mid-run (engine/scheduler.py
+#: _flush_body zeroes the write-ring cursor at every run() boundary, so
+#: its "final" value is not the value the last latch saw)
+EXCLUDE = ("wr_ring_cursor",)
+
+#: derived int column: the mesh observatory's whole-plane row sum
+#: (obs/mesh.py; [summary] mesh_tx_total), latched when the plane rides
+MESH_COL = "mesh_tx_total"
+
+#: stamp column (always column 0 of the int ring): the 1-based tick the
+#: snapshot was latched at — row w must stamp (w+1) * window_ticks, the
+#: contiguity check that catches a lost window
+TICK_COL = "tick"
+
+
+def columns(stats: dict, db: dict, stacked: bool = False):
+    """The window vocabulary, derived from the carried dicts: sorted
+    ``(int_cols, float_cols)`` name tuples.  ``stacked`` reads the
+    host-side node-stacked view (scalars carry a leading node axis).
+    Deterministic in the key sets only, so the device latch and every
+    host consumer agree by construction."""
+    nd = 1 if stacked else 0
+    ints, floats = [TICK_COL], []
+    for k in sorted(stats):
+        if k.startswith(("arr_", "window_")) or k in EXCLUDE:
+            continue
+        v = stats[k]
+        if v.ndim != nd:
+            continue
+        if v.dtype == jnp.int32:
+            ints.append(k)
+        elif v.dtype == jnp.float32:
+            floats.append(k)
+    ints += [k for k in sorted(db)
+             if k.endswith("_cnt") and db[k].ndim == nd
+             and db[k].dtype == jnp.int32]
+    if "arr_mesh_tx" in stats:
+        ints.append(MESH_COL)
+    return tuple(ints), tuple(floats)
+
+
+def init_windows(cfg, stats: dict, db: dict) -> dict:
+    """Stats-dict entries for the snapshot plane; empty when
+    ``Config.windows`` is off (the disabled path carries nothing).
+    Called AFTER the rest of the carry exists — the ring widths are the
+    derived vocabulary's, so they see every other observatory's
+    scalars."""
+    if not cfg.windows:
+        return {}
+    ints, floats = columns(stats, db)
+    S = cfg.window_slots
+    return {
+        "arr_window_i32": jnp.zeros((S, len(ints)), jnp.int32),
+        "arr_window_f32": jnp.zeros((S, len(floats)), jnp.float32),
+        # cumulative latch count: ring cursor (mod S) AND wrap detector
+        # in one scalar, the flight-recorder idiom.  arr_-prefixed so
+        # neither engine's scalar scrape nor the sharded counter psum
+        # picks it up (it is per-node bookkeeping, not a counter).
+        "arr_window_cnt": jnp.zeros((), jnp.int32),
+    }
+
+
+def latch(cfg, stats: dict, db: dict, t) -> dict:
+    """Jit-pure end-of-tick latch: every ``window_ticks``-th tick, copy
+    the cumulative vocabulary into the next ring row (keep-last: write
+    position ``cnt % S``).  Off ticks scatter to the out-of-bounds row
+    and drop — unconditional compute, no lax.cond, so the traced graph
+    is tick-invariant (zero post-warm recompiles).  No-op when the
+    plane is off."""
+    if "arr_window_cnt" not in stats:
+        return stats
+    ints, floats = columns(stats, db)
+    W = jnp.int32(cfg.window_ticks)
+    cnt = stats["arr_window_cnt"]
+    do = (t + 1) % W == 0
+
+    def value(k):
+        if k == TICK_COL:
+            return t + 1
+        if k == MESH_COL:
+            return jnp.sum(stats["arr_mesh_tx"]).astype(jnp.int32)
+        return stats[k] if k in stats else db[k]
+
+    ring_i = stats["arr_window_i32"]
+    ring_f = stats["arr_window_f32"]
+    S = ring_i.shape[0]
+    pos = jnp.where(do, cnt % S, S)
+    row_i = jnp.stack([value(k).astype(jnp.int32) for k in ints])
+    row_f = jnp.stack([value(k).astype(jnp.float32) for k in floats])
+    return {**stats,
+            "arr_window_i32": ring_i.at[pos].set(
+                row_i, mode="drop", unique_indices=True),
+            "arr_window_f32": ring_f.at[pos].set(
+                row_f, mode="drop", unique_indices=True),
+            "arr_window_cnt": cnt + do.astype(jnp.int32)}
+
+
+# ---------------------------------------------------------------------------
+# host side: snapshot / deltas / reconcile
+# ---------------------------------------------------------------------------
+
+def _stacked(stats: dict) -> bool:
+    return np.asarray(stats["arr_window_cnt"]).ndim == 1
+
+
+def snapshot(cfg, stats: dict, db: dict) -> dict:
+    """Host view of the window plane: cluster rings (node axis summed
+    for the int columns — the exact merge; float columns summed the
+    same way the cluster summary host-sums its float scalars), the
+    latch count, and the final cumulative counters read from the SAME
+    dicts, for :func:`reconcile`.  ``None`` when the plane is off."""
+    if "arr_window_cnt" not in stats:
+        return None
+    stacked = _stacked(stats)
+    ints, floats = columns(stats, db, stacked=stacked)
+    ring_i = np.asarray(stats["arr_window_i32"])
+    ring_f = np.asarray(stats["arr_window_f32"])
+    cnts = np.asarray(stats["arr_window_cnt"])
+    if stacked:
+        nodes = ring_i.shape[0]
+        # lockstep tick clock: every node latches the same windows, so
+        # the stacked rings align row-for-row and merge by adding —
+        # except the tick stamp, identical across nodes (keep one copy)
+        stamp = ring_i[0, :, 0]
+        ring_i = ring_i.sum(axis=0, dtype=np.int64)
+        ring_i[:, 0] = stamp
+        ring_f = ring_f.sum(axis=0, dtype=np.float64)
+        cnt = int(cnts.max())
+    else:
+        nodes, cnt = 1, int(cnts)
+        ring_i = ring_i.astype(np.int64)
+        ring_f = ring_f.astype(np.float64)
+
+    def final(k, cast):
+        if k == TICK_COL:
+            return None
+        if k == MESH_COL:
+            return int(np.asarray(stats["arr_mesh_tx"]).sum())
+        v = np.asarray(stats[k] if k in stats else db[k])
+        return cast(v.sum()) if stacked else cast(v)
+
+    return {"cols_i": ints, "cols_f": floats,
+            "ring_i": ring_i, "ring_f": ring_f,
+            "cnt": cnt, "cnts": cnts if stacked else np.asarray([cnt]),
+            "slots": ring_i.shape[0], "nodes": nodes,
+            "window_ticks": cfg.window_ticks,
+            "final_i": {k: final(k, int) for k in ints if k != TICK_COL},
+            "final_f": {k: final(k, float) for k in floats}}
+
+
+def n_valid(snap: dict) -> int:
+    """Rows of the ring holding live snapshots (all of them once the
+    run latched ``slots`` windows)."""
+    return min(snap["cnt"], snap["slots"])
+
+
+def wrapped(snap: dict) -> bool:
+    return snap["cnt"] > snap["slots"]
+
+
+def deltas(snap: dict) -> dict:
+    """Per-window delta rows: adjacent-snapshot differences with the
+    zero init as the baseline — ``{"ticks": (V,), "int": (V, Ki) by
+    cols_i, "float": (V, Kf) by cols_f}`` over the V valid windows (in
+    latch order; meaningful only while the ring has not wrapped)."""
+    v = n_valid(snap)
+    ring_i, ring_f = snap["ring_i"][:v], snap["ring_f"][:v]
+    base_i = np.zeros((1, ring_i.shape[1]), ring_i.dtype)
+    base_f = np.zeros((1, ring_f.shape[1]), ring_f.dtype)
+    return {"ticks": ring_i[:, 0].copy(),
+            "int": np.diff(ring_i, axis=0, prepend=base_i),
+            "float": np.diff(ring_f, axis=0, prepend=base_f)}
+
+
+def reconcile(snap: dict, summary: dict | None = None) -> list:
+    """Findings list proving the window identity (empty == clean):
+
+    - ``window_ring_wrapped``: more windows latched than kept — the
+      loud refusal; a wrapped ring cannot prove anything below.
+    - ``window_cnt_skew``: sharded nodes disagree on the latch count
+      (the tick clock is lockstep; disagreement is a latch bug).
+    - ``window_tick_stamp``: row w not stamped ``(w+1) * window_ticks``
+      — a lost or misplaced window.
+    - ``window_int_identity``: sum of per-window deltas != the final
+      cumulative counter, per int column (exact, int arithmetic).
+    - ``window_float_final``: last snapshot != the final carry value,
+      per float column (the float form of the identity: the telescoped
+      delta sum IS the last snapshot).
+    - ``window_summary_drift``: a column's final disagrees with the
+      engine summary dict, when one is passed (same vocabulary, same
+      values — catches a scrape/latch divergence).
+    """
+    bad = []
+    if wrapped(snap):
+        bad.append(("window_ring_wrapped", snap["cnt"], snap["slots"]))
+        return bad
+    if int(snap["cnts"].min()) != int(snap["cnts"].max()):
+        bad.append(("window_cnt_skew", snap["cnts"].tolist()))
+        return bad
+    W, v = snap["window_ticks"], n_valid(snap)
+    d = deltas(snap)
+    want = np.arange(1, v + 1, dtype=np.int64) * W
+    if not np.array_equal(d["ticks"], want):
+        bad.append(("window_tick_stamp", d["ticks"].tolist(),
+                    want.tolist()))
+    sums = d["int"].sum(axis=0)
+    for j, k in enumerate(snap["cols_i"]):
+        if k == TICK_COL:
+            continue
+        if int(sums[j]) != snap["final_i"][k]:
+            bad.append(("window_int_identity", k, int(sums[j]),
+                        snap["final_i"][k]))
+    last_f = (snap["ring_f"][v - 1] if v
+              else np.zeros(len(snap["cols_f"])))
+    for j, k in enumerate(snap["cols_f"]):
+        if float(last_f[j]) != snap["final_f"][k]:
+            bad.append(("window_float_final", k, float(last_f[j]),
+                        snap["final_f"][k]))
+    if summary is not None:
+        for k, fin in snap["final_i"].items():
+            if k in summary and k != "measured_ticks" \
+                    and int(summary[k]) != fin:
+                bad.append(("window_summary_drift", k, fin,
+                            int(summary[k])))
+    return bad
+
+
+def summary_keys(cfg, stats: dict) -> dict:
+    """``window_*`` [summary] keys (merged only when the plane is on):
+    the latch count (max across nodes — lockstep, reconcile pins the
+    skew), wrap verdict, and the ring geometry the host needs to
+    re-derive windows from the record."""
+    cnts = np.asarray(stats["arr_window_cnt"])
+    cnt = int(cnts.max())
+    return {"window_cnt": cnt,
+            "window_wrapped": int(cnt > cfg.window_slots),
+            "window_slots": cfg.window_slots,
+            "window_ticks_per": cfg.window_ticks}
+
+
+def record_extra(cfg, stats: dict, db: dict) -> dict:
+    """Run-record extra block (obs/profiler.py write_run_record): the
+    full window plane as JSON-serializable lists, so obs/diff.py can
+    segment a recorded run without the device arrays.  ``{}`` when the
+    plane is off."""
+    snap = snapshot(cfg, stats, db)
+    if snap is None:
+        return {}
+    v = n_valid(snap)
+    return {"windows": {
+        "cols_i": list(snap["cols_i"]), "cols_f": list(snap["cols_f"]),
+        "ring_i": snap["ring_i"][:v].tolist(),
+        "ring_f": snap["ring_f"][:v].tolist(),
+        "cnt": snap["cnt"], "slots": snap["slots"],
+        "window_ticks": snap["window_ticks"], "nodes": snap["nodes"],
+        "wrapped": wrapped(snap)}}

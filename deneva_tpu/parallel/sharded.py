@@ -67,6 +67,7 @@ from deneva_tpu.obs import flight as obs_flight
 from deneva_tpu.obs import histo as obs_histo
 from deneva_tpu.obs import mesh as obs_mesh
 from deneva_tpu.obs import trace as obs_trace
+from deneva_tpu.obs import windows as obs_windows
 from deneva_tpu.obs.prog import ProgressEmitter
 from deneva_tpu.obs.profiler import PhaseProfiler
 from deneva_tpu.obs.xmeter import XMeter, ledger_totals, state_ledger
@@ -1693,6 +1694,10 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
                      + dbg.count_violations(cfg, plugin, txn)}
 
         stats = bump(stats, "measured_ticks", 1, measuring)
+        # windowed counter snapshots (obs/windows.py): the shard_map
+        # body sees single-node shapes, so the single-shard latch
+        # serves unchanged — one ring per node, merged host/psum-side
+        stats = obs_windows.latch(cfg, stats, db, t)
         return ShardState(txn=txn, db=db, data=data, tables=tables,
                           stats=stats, tick=t + 1,
                           pool_cursor=(state.pool_cursor + n_free) % Q,
@@ -1856,12 +1861,7 @@ class ShardedEngine:
                           cfg.remote_cache_buckets, jnp.int32),
                       **{"rc_" + f: jnp.zeros((B, R), jnp.int32)
                          for f in self.plugin.remote_cache_fields}}
-            return ShardState(
-                txn=TxnState.empty(B, R, A=self.pool.args.shape[1]),
-                db=db,
-                data=jnp.zeros(rows_local, jnp.int32),
-                tables=self.workload.init_tables(cfg, part),
-                stats={**_zeros_stats(
+            stats = {**_zeros_stats(
                            cfg,
                            n_families=int(self.pool.txn_type.max()) + 1),
                        **{k: jnp.zeros((), jnp.int32)
@@ -1924,7 +1924,17 @@ class ShardedEngine:
                            "reship_suppressed_cnt":
                            jnp.zeros((), jnp.int32)}
                           if cfg.remote_cache
-                          and self.plugin.remote_cache_ok else {})},
+                          and self.plugin.remote_cache_ok else {})}
+            # window snapshot plane LAST (obs/windows.py): its ring
+            # widths are the derived column vocabulary, which must see
+            # every scalar above plus the db plugin counters
+            stats.update(obs_windows.init_windows(cfg, stats, db))
+            return ShardState(
+                txn=TxnState.empty(B, R, A=self.pool.args.shape[1]),
+                db=db,
+                data=jnp.zeros(rows_local, jnp.int32),
+                tables=self.workload.init_tables(cfg, part),
+                stats=stats,
                 tick=jnp.zeros((), jnp.int32),
                 pool_cursor=jnp.zeros((), jnp.int32),
                 ts_counter=jnp.ones((), jnp.int32),
@@ -2099,6 +2109,12 @@ class ShardedEngine:
                 np.asarray(state.stats["arr_mesh_tx"]).sum())
             out["imb_jain"] = obs_mesh.jain(
                 np.asarray(state.stats["txn_cnt"]))
+        if "arr_window_cnt" in state.stats:
+            # window snapshot plane (obs/windows.py): latch count (max
+            # across lockstep nodes), wrap verdict and ring geometry —
+            # merged only when the plane is on.  The float(...sum())
+            # scrape above never sees the plane (arr_ prefix).
+            out.update(obs_windows.summary_keys(self.cfg, state.stats))
         return out
 
     def mesh_snapshot(self, state: ShardState) -> dict:
@@ -2117,6 +2133,21 @@ class ShardedEngine:
         bit-exact equal to the host ``sum(axis=0)`` of the node-stacked
         per-shard planes (exact merge: elementwise int32 add)."""
         return obs_histo.cluster_plane(self.mesh, state.stats[key])
+
+    def window_snapshot(self, state: ShardState) -> dict | None:
+        """Host-side window-plane snapshot (obs/windows.py): cluster
+        rings (node axis summed) + final counters for deltas and the
+        identity reconcile; None when windows is off."""
+        return obs_windows.snapshot(self.cfg, state.stats, state.db)
+
+    def window_cluster_plane(self, state: ShardState) -> np.ndarray:
+        """Device-psum'd ``(S, Ki)`` cluster window ring over the node
+        axis — bit-exact equal to the host ``sum(axis=0)`` of the
+        stacked per-node int rings (exact merge: elementwise int32 add;
+        the same ``counters.cluster_sum`` collective as the histogram
+        plane).  The tick-stamp column psums to N x tick."""
+        return obs_histo.cluster_plane(self.mesh,
+                                       state.stats["arr_window_i32"])
 
     def ledger(self, state: ShardState) -> list:
         """Cluster HBM footprint rows (obs/xmeter.py state_ledger): the

@@ -208,6 +208,15 @@ def reference_summary(s: dict, wall_seconds: float | None = None) -> dict:
             out[k] = s[k]
         elif k.startswith("slo_") and k not in out:
             out[k] = s[k] * tick_sec if isinstance(s[k], float) else s[k]
+    # causal-diagnosis observatory keys (Config.windows, obs/windows.py
+    # + obs/diff.py): the snapshot-ring bookkeeping (latch count, wrap
+    # flag, ring geometry) and any diag_* diagnosis gauges pass through
+    # verbatim (integers and dimensionless scores — never time-scaled).
+    # Present only when the window plane is on, so the default line
+    # stays byte-identical.
+    for k in sorted(s):
+        if k.startswith(("window_", "diag_")) and k not in out:
+            out[k] = s[k]
     # reference-name ALIASES for the invented chain counters, so parsers
     # of reference-format summaries (stats.cpp:907 prints case1..6) keep
     # their maat_caseN_cnt fields.  The reference's case2/4/5 fire against

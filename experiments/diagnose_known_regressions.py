@@ -1,0 +1,162 @@
+"""Acceptance experiment: the comparator re-derives the hand findings.
+
+Two regressions in this repo's history were diagnosed BY HAND from
+counter dumps before obs/diff.py existed:
+
+1. the flat MAAT scaling curve (EXPERIMENTS.md "Diagnosing the flat
+   MAAT scaling curve"): the 8-node cell commits ~1x the 1-node cell
+   because every multi-partition access re-ships remote grants —
+   remote amplification, NOT load imbalance (Jain stays >= 0.99 across
+   the grid) — and the fix was ``Config.remote_cache``;
+2. the NO_WAIT hot-cell collapse (EXPERIMENTS.md "Adaptive contention
+   controller", known limit): on the saturated hot set (ACCESS_PERC
+   0.95 of DATA_PERC 0.001 — ~4 rows) the controller's escalation gate
+   serializes writers one-per-tick on keys that were ALREADY wedged,
+   so adaptive lands ~9x below the best static ladder point.
+
+This script re-runs both pairs at CI scale and feeds the raw summaries
+to ``obs/diff.py`` with NO other input.  Acceptance: the top-ranked
+cause must name remote amplification (lever ``remote_cache``) for (1)
+and an escalation-family cause (lever ``adaptive``) for (2) — i.e. the
+automated triage reproduces what previously took a human reading
+counter dumps.  Imbalance must NOT outrank amplification in (1).
+
+Usage:  python experiments/diagnose_known_regressions.py
+          [--grid-ticks N] [--hot-ticks N] [-o results/...]
+
+Writes ``results/diagnosis_acceptance.json`` (both full diagnosis
+dicts + verdicts); exit 0 only when BOTH verdicts match the hand
+findings.  EXPERIMENTS.md "Causal diagnosis observatory" records a
+run; scripts/check.sh runs a shorter single-engine smoke instead.
+"""
+
+from __future__ import annotations
+
+import os
+
+# virtual 8-device CPU mesh for the sharded cells (SURVEY.md §4); forced
+# BEFORE jax import, like tests/conftest.py and run_grid.py workers
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import argparse  # noqa: E402
+import dataclasses  # noqa: E402
+import json  # noqa: E402
+import sys  # noqa: E402
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from deneva_tpu.config import Config  # noqa: E402
+from deneva_tpu.obs import diff as obs_diff  # noqa: E402
+
+RESULTS = os.path.join(os.path.dirname(__file__), "..", "results")
+
+
+def _record(eng, st):
+    """The run-record shape diff_records consumes (summary + config)."""
+    return {"summary": eng.summary(st),
+            "config": dataclasses.asdict(eng.cfg)}
+
+
+def run_maat_pair(n_ticks: int):
+    """The scaling-grid 8x32 MAAT cell, remote_cache ON (healthy A) vs
+    OFF (the flat curve, B) — bench.py run_scaling_grid's exact cell
+    shape (GRID_KW, mesh on, part_per_txn=2)."""
+    from bench import GRID_KW
+    from deneva_tpu.parallel.sharded import ShardedEngine
+
+    recs = {}
+    for name, extra in (("maat8x32+rc", {"remote_cache": True}),
+                        ("maat8x32", {})):
+        cfg = Config(cc_alg="MAAT", node_cnt=8, part_cnt=8,
+                     batch_size=32, part_per_txn=2, mesh=True,
+                     **GRID_KW, **extra)
+        eng = ShardedEngine(cfg)
+        st = eng.run_compiled(n_ticks)
+        recs[name] = _record(eng, st)
+        s = recs[name]["summary"]
+        print(f"[cell] {name}: txn_cnt={s['txn_cnt']} "
+              f"remote_entry_cnt={s.get('remote_entry_cnt', 0)} "
+              f"imb_jain={s.get('imb_jain', 0):.3f}", flush=True)
+    return obs_diff.diff_records(recs["maat8x32+rc"], recs["maat8x32"],
+                                 "maat8x32+rc", "maat8x32")
+
+
+def run_hot_pair(n_ticks: int):
+    """The adaptive sweep's NO_WAIT hot cell, best-known static backoff
+    (A) vs the adaptive controller (B) — bench.py run_adaptive's exact
+    cell shape (ADAPT_KW + the hot-skew knobs)."""
+    from bench import ADAPT_KW
+    from deneva_tpu.engine.scheduler import Engine
+
+    hot = dict(skew_method="hot", access_perc=0.95, data_perc=0.001)
+    recs = {}
+    for name, extra in (("nowait@hot/p4", {"abort_penalty_ticks": 4}),
+                        ("nowait@hot/adaptive",
+                         {"adaptive": True, "heatmap_bins": 64})):
+        cfg = Config(cc_alg="NO_WAIT", abort_attribution=True,
+                     **ADAPT_KW, **hot, **extra)
+        eng = Engine(cfg)
+        st = eng.run_compiled(n_ticks)
+        recs[name] = _record(eng, st)
+        s = recs[name]["summary"]
+        print(f"[cell] {name}: txn_cnt={s['txn_cnt']} "
+              f"escalations={s.get('ctrl_escalate_cnt', 0)} "
+              f"gate_blocks={s.get('ctrl_esc_block_cnt', 0)}", flush=True)
+    return obs_diff.diff_records(recs["nowait@hot/p4"],
+                                 recs["nowait@hot/adaptive"],
+                                 "nowait@hot/p4", "nowait@hot/adaptive")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--grid-ticks", type=int, default=48,
+                   help="ticks per sharded MAAT cell")
+    p.add_argument("--hot-ticks", type=int, default=160,
+                   help="ticks per NO_WAIT hot cell")
+    p.add_argument("-o", "--out",
+                   default=os.path.join(RESULTS,
+                                        "diagnosis_acceptance.json"))
+    args = p.parse_args(argv)
+
+    print("== finding 1: flat MAAT scaling (expect remote_amplification"
+          " / remote_cache) ==", flush=True)
+    d_grid = run_maat_pair(args.grid_ticks)
+    print(obs_diff.render_diagnosis(d_grid), flush=True)
+    amp = next((c for c in d_grid["causes"]
+                if c["cause"] == "remote_amplification"), None)
+    imb = next((c for c in d_grid["causes"]
+                if c["cause"] == "imbalance"), None)
+    grid_ok = (d_grid["top_cause"] == "remote_amplification"
+               and d_grid["top_lever"] == "remote_cache"
+               and amp is not None and amp["regressing"]
+               and (imb is None or imb["score"] < amp["score"]))
+
+    print("\n== finding 2: NO_WAIT hot-cell collapse (expect escalation"
+          " family / adaptive) ==", flush=True)
+    d_hot = run_hot_pair(args.hot_ticks)
+    print(obs_diff.render_diagnosis(d_hot), flush=True)
+    hot_ok = (d_hot["top_cause"] in ("ctrl_escalations_per_commit",
+                                     "ctrl_gate_stalls_per_commit")
+              and d_hot["top_lever"] == "adaptive")
+
+    doc = {"maat_scaling": {"diff": d_grid, "reproduced": grid_ok,
+                            "expect": "remote_amplification/remote_cache"},
+           "nowait_hot": {"diff": d_hot, "reproduced": hot_ok,
+                          "expect": "ctrl_*_per_commit/adaptive"}}
+    os.makedirs(RESULTS, exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(f"\n[acceptance] maat_scaling reproduced: {grid_ok}; "
+          f"nowait_hot reproduced: {hot_ok}; wrote {args.out}")
+    return 0 if (grid_ok and hot_ok) else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -501,6 +501,55 @@ if [ "$slo_rc" -ne 0 ]; then
     exit "$slo_rc"
 fi
 
+echo "== causal diagnosis smoke (windowed deltas + differential diff) =="
+# the diagnosis observatory end to end (deneva_tpu/obs/{windows,diff}.py):
+# two short windowed runs differing by ONE knob (the CC plugin) must each
+# prove the sum-of-deltas identity on the live engine ("[windows] ...
+# identity OK" is a hard exit gate inside bench.py), diffing their
+# records must emit a [diagnosis] whose ranked causes name a config
+# lever, and the within-run phase split (--diff REC --windows) must
+# segment the same record exactly
+diag_dir=$(mktemp -d)
+diag_rc=0
+for alg in NO_WAIT WAIT_DIE; do
+    env JAX_PLATFORMS=cpu python bench.py --windows --window-ticks 8 \
+        --ticks 64 --cc-alg "$alg" --no-history --out-dir "$diag_dir" \
+        > "$diag_dir/$alg.log" 2>&1 || diag_rc=$?
+    grep -q "identity OK" "$diag_dir/$alg.log" || diag_rc=1
+done
+if [ "$diag_rc" -eq 0 ]; then
+    rec_a=$(sed -n 's/^\[obs\] run record: //p' "$diag_dir/NO_WAIT.log")
+    rec_b=$(sed -n 's/^\[obs\] run record: //p' "$diag_dir/WAIT_DIE.log")
+    env JAX_PLATFORMS=cpu python -m deneva_tpu.obs.diff \
+        "$rec_a" "$rec_b" -o "$diag_dir/diag.json" \
+        > "$diag_dir/diff.log" 2>&1 || diag_rc=$?
+    env JAX_PLATFORMS=cpu python bench.py --diff "$rec_b" --windows \
+        >> "$diag_dir/diff.log" 2>&1 || diag_rc=$?
+fi
+if [ "$diag_rc" -eq 0 ]; then
+    env JAX_PLATFORMS=cpu python - "$diag_dir" <<'PYEOF'
+import json, os, sys
+d = sys.argv[1]
+log = open(os.path.join(d, "diff.log")).read()
+assert log.count("[diagnosis]") == 2, "run diff + window diff reports"
+diag = json.load(open(os.path.join(d, "diag.json")))
+assert diag["kind"] == "run_diff" and diag["causes"], diag
+assert diag["top_cause"] and diag["top_lever"], diag
+# the one-knob delta must surface in the abort taxonomy: the WAIT_DIE
+# side aborts by wound, the NO_WAIT side by immediate conflict
+names = {c["cause"] for c in diag["causes"]}
+assert any(n.startswith("abort_mix[") for n in names), names
+print(f"[diff] {len(diag['causes'])} ranked cause(s); verdict "
+      f"{diag['top_cause']} -> Config.{diag['top_lever']}")
+PYEOF
+    diag_rc=$?
+fi
+rm -rf "$diag_dir"
+if [ "$diag_rc" -ne 0 ]; then
+    echo "causal diagnosis smoke FAILED (identity/diff rc=$diag_rc)"
+    exit "$diag_rc"
+fi
+
 echo "== bench regression gate =="
 # gate the latest trajectory point (committed BENCH_r*.json snapshots +
 # any results/bench_history.jsonl) against the median of its priors;
@@ -546,7 +595,7 @@ fi
 
 echo "== tier-1 pytest =="
 rm -f /tmp/_t1.log
-timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+timeout -k 10 1080 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}

@@ -1,0 +1,147 @@
+"""Differential run comparator tests (obs/diff.py): cause ranking on
+synthetic records, the lever map, window-vs-window segmentation, the
+regress-gate triage, and the same-platform gate filter."""
+
+import numpy as np
+import pytest
+
+from deneva_tpu.obs import diff as obs_diff
+from deneva_tpu.obs import regress as obs_regress
+
+BASE_SUMMARY = dict(
+    txn_cnt=1000, total_txn_abort_cnt=200, measured_ticks=100,
+    lat_process_time=3000.0, lat_cc_block_time=1000.0,
+    lat_abort_time=500.0, lat_network_time=200.0,
+    txn_total_time_ticks=8000.0, remote_entry_cnt=0, imb_jain=0.99,
+    abort_nowait_conflict_cnt=150, abort_compact_spill_cnt=50)
+
+
+def test_remote_amplification_ranks_top():
+    # the PR 9 scenario in miniature: run B commits less while shipping
+    # ~8x remote entries per access; a near-constant imbalance and mild
+    # abort growth must NOT outrank it.  The extractor is bench.py's
+    # scaling-grid formula (remote_entry_cnt / (txn_cnt * req_per_query))
+    b = dict(BASE_SUMMARY, txn_cnt=400, remote_entry_cnt=400 * 16 * 8,
+             lat_network_time=9000.0, imb_jain=0.98,
+             txn_total_time_ticks=20000.0)
+    cfg = {"req_per_query": 16}
+    d = obs_diff.diff_summaries(BASE_SUMMARY, b, cfg, cfg)
+    assert d["top_cause"] == "remote_amplification"
+    assert d["top_lever"] == "remote_cache"
+    amp = next(c for c in d["causes"]
+               if c["cause"] == "remote_amplification")
+    assert amp["b"] == pytest.approx(8.0)
+    assert amp["regressing"]
+    imb = next(c for c in d["causes"] if c["cause"] == "imbalance")
+    assert imb["score"] < 0.1 < amp["score"]
+
+
+def test_escalation_serialization_ranks_top():
+    # the PR 13 hot-cell scenario: the controller escalates the
+    # saturated hot set and serializes the batch — gate stalls and
+    # escalations per commit explode while remote traffic is absent
+    b = dict(BASE_SUMMARY, txn_cnt=300,
+             ctrl_escalate_cnt=280, ctrl_esc_block_cnt=250,
+             lat_cc_block_time=4000.0)
+    a = dict(BASE_SUMMARY, ctrl_escalate_cnt=5, ctrl_esc_block_cnt=2)
+    d = obs_diff.diff_summaries(a, b)
+    assert d["top_cause"] in ("ctrl_escalations_per_commit",
+                              "ctrl_gate_stalls_per_commit")
+    assert d["top_lever"] == "adaptive"
+
+
+def test_abort_mix_maps_reason_families_to_levers():
+    b = dict(BASE_SUMMARY, abort_compact_spill_cnt=600,
+             abort_route_overflow_cnt=300, total_txn_abort_cnt=1100)
+    d = obs_diff.diff_summaries(BASE_SUMMARY, b)
+    by = {c["cause"]: c for c in d["causes"]}
+    assert by["abort_mix[compact_spill]"]["lever"] == "compact_auto"
+    assert by["abort_mix[route_overflow]"]["lever"] == "exchange_split"
+    assert by["abort_mix[nowait_conflict]"]["lever"] == "adaptive"
+
+
+def test_absent_planes_ride_as_zero_not_crash():
+    # a cause joins only when either side carries its probe key; a
+    # summary pair without controller/SLO/mesh planes must diff cleanly
+    a = {"txn_cnt": 10, "measured_ticks": 5, "total_txn_abort_cnt": 0}
+    d = obs_diff.diff_summaries(a, dict(a, txn_cnt=20))
+    names = {c["cause"] for c in d["causes"]}
+    assert "ctrl_escalations_per_commit" not in names
+    assert "burn_fast" not in names
+
+
+def test_window_segmentation_is_exact_and_refuses_wrap():
+    cols_i = ["tick", "txn_cnt", "total_txn_abort_cnt", "measured_ticks"]
+    ring = [[4, 10, 2, 4], [8, 15, 8, 8], [12, 40, 9, 12]]
+    rec = {"config": {}, "summary": {},
+           "windows": {"cols_i": cols_i, "cols_f": ["lat_abort_time"],
+                       "ring_i": ring, "ring_f": [[1.0], [4.0], [6.0]],
+                       "cnt": 3, "slots": 8, "window_ticks": 4,
+                       "nodes": 1, "wrapped": False}}
+    sa, sb, split = obs_diff.segment_summaries(rec, split_tick=8)
+    assert (sa["txn_cnt"], sb["txn_cnt"]) == (15, 25)
+    assert (sa["measured_ticks"], sb["measured_ticks"]) == (8, 4)
+    assert sa["lat_abort_time"] + sb["lat_abort_time"] == 6.0
+    d = obs_diff.diff_windows(rec, split_tick=8)
+    assert d["kind"] == "window_diff" and d["split_tick"] == 8
+    rec["windows"]["wrapped"] = True
+    rec["windows"]["cnt"] = 99
+    with pytest.raises(ValueError, match="wrapped"):
+        obs_diff.segment_summaries(rec)
+
+
+def _entry(i, amp, eff, platform=None, value=10.0):
+    doc = {"metric": "scaling_grid", "value": value,
+           "scaling_grid": {"MAAT@8x256": {"efficiency": eff,
+                                           "amplification": amp}}}
+    if platform:
+        doc["platform"] = platform
+    return obs_regress._entry(f"p{i}", (1, i), doc)
+
+
+def test_failing_gate_attaches_ranked_diagnosis():
+    # an amplification blow-up fails the inverted gate AND arrives
+    # pre-triaged: the diagnosis names the cell and the remote_cache
+    # lever without any human reading counters
+    hist = [_entry(i, 1.0, 0.9) for i in range(3)]
+    res = obs_regress.gate(hist + [_entry(9, 8.44, 0.24)])
+    assert res["failures"]
+    diag = res["diagnosis"]
+    assert diag["top_cause"] == "amplification[MAAT@8x256]"
+    assert diag["top_lever"] == "remote_cache"
+    text = obs_regress.render_text(res)
+    assert "[diagnosis]" in text
+    # a clean gate attaches nothing
+    ok = obs_regress.gate(hist + [_entry(9, 1.0, 0.9)])
+    assert not ok["failures"] and "diagnosis" not in ok
+
+
+def test_gate_is_platform_scoped():
+    # satellite 1: a cpu point must gate only against cpu (and legacy
+    # untagged) priors — tpu history with far higher cells must neither
+    # fail it nor lower its median
+    tpu = [_entry(i, 1.0, 0.9, platform="tpu", value=100.0)
+           for i in range(4)]
+    cur = _entry(9, 1.0, 0.2, platform="cpu", value=5.0)
+    res = obs_regress.gate(tpu + [cur])
+    assert res["failures"] == []
+    assert all("no prior data" in s for s in res["skipped"])
+    # same-platform priors DO gate it
+    cpu = [_entry(i, 1.0, 0.9, platform="cpu") for i in range(3)]
+    res2 = obs_regress.gate(cpu + [cur])
+    assert any("scaling_grid_efficiency" in f for f in res2["failures"])
+    # legacy untagged priors keep gating a tagged current
+    legacy = [_entry(i, 1.0, 0.9) for i in range(3)]
+    res3 = obs_regress.gate(legacy + [cur])
+    assert any("scaling_grid_efficiency" in f for f in res3["failures"])
+
+
+def test_render_diagnosis_names_verdict_and_lever():
+    a = dict(BASE_SUMMARY)
+    b = dict(BASE_SUMMARY, remote_entry_cnt=32000, txn_cnt=500)
+    d = obs_diff.diff_summaries(a, b, {"req_per_query": 4},
+                                {"req_per_query": 4})
+    text = obs_diff.render_diagnosis(d)
+    assert text.startswith("[diagnosis]")
+    assert "verdict: remote_amplification" in text
+    assert "Config.remote_cache" in text

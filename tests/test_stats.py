@@ -295,6 +295,45 @@ def test_scale_out_keys_round_trip_exactly():
                    for k in p0)
 
 
+def test_window_keys_round_trip_exactly():
+    """Windowed runs (Config.windows, obs/windows.py) put the snapshot-
+    ring bookkeeping on the [summary] line; the stats layer passes the
+    window_*/diag_* families through VERBATIM (integers and
+    dimensionless scores, never time-scaled), they round-trip through
+    the parser port with EXACT key names, and the default line carries
+    none of them."""
+    eng = Engine(Config(cc_alg="NO_WAIT", batch_size=64,
+                        synth_table_size=1 << 10, req_per_query=4,
+                        zipf_theta=0.8, query_pool_size=1 << 10,
+                        warmup_ticks=0, windows=True, window_ticks=4,
+                        window_slots=16))
+    st = eng.run(16)
+    s = eng.summary(st)
+    # the engine itself emits exactly the four bookkeeping keys
+    assert {k for k in s if k.startswith("window_")} \
+        == {"window_cnt", "window_wrapped", "window_slots",
+            "window_ticks_per"}
+    assert (s["window_cnt"], s["window_wrapped"]) == (4, 0)
+    # diag_* gauges ride the same verbatim lane (host-side injection,
+    # the mesh/fault passthrough discipline)
+    diag = {"diag_top_score_milli": 940}
+    d1 = stats_mod.reference_summary({**s, **diag})
+    d2 = stats_mod.reference_summary({**s, **diag},
+                                     wall_seconds=s["measured_ticks"]
+                                     * 2.0)
+    for k in ("window_cnt", "window_wrapped", "window_slots",
+              "window_ticks_per", "diag_top_score_milli"):
+        assert d1[k] == d2[k] == ({**s, **diag})[k], k   # never scaled
+    parsed = stats_mod.parse_summary(stats_mod.format_summary(d1))
+    for k in ("window_cnt", "window_wrapped", "window_slots",
+              "window_ticks_per", "diag_top_score_milli"):
+        assert parsed[k] == d1[k], k
+    # the default (windows-off) line carries none of them
+    eng0, st0 = run_engine()
+    p0 = stats_mod.parse_summary(eng0.summary_line(st0, wall_seconds=1.0))
+    assert not any(k.startswith(("window_", "diag_")) for k in p0)
+
+
 def test_slo_keys_round_trip_exactly():
     """SLO-plane runs (Config.slo, obs/histo.py + obs/slo.py) put the
     exact-histogram percentiles and the error-budget fields on the

@@ -1,0 +1,123 @@
+"""Causal-diagnosis window plane tests (Config.windows, obs/windows.py):
+the sum-of-deltas identity must hold EXACTLY for every CC plugin, the
+off path must stay byte-identical, wrap must refuse loudly, and the
+latch must cost zero post-warmup recompiles."""
+
+import numpy as np
+import pytest
+
+from deneva_tpu.config import Config
+from deneva_tpu.engine.scheduler import Engine
+from deneva_tpu.obs import windows as obs_windows
+
+# all 7 registered plugins; the run is 24 ticks on a tiny cell, so the
+# whole sweep stays inside the tier-1 budget (the heavy compiles —
+# MAAT's chain-validate — are already paid by other tier-1 cells)
+ALL_ALGS = ["NO_WAIT", "WAIT_DIE", "TIMESTAMP", "MVCC", "OCC", "MAAT",
+            "CALVIN"]
+
+BASE = dict(batch_size=64, synth_table_size=1 << 10, req_per_query=4,
+            zipf_theta=0.8, tup_read_perc=0.5, query_pool_size=1 << 10,
+            warmup_ticks=0)
+
+
+def run_windowed(n_ticks=24, **kw):
+    eng = Engine(Config(**{**BASE, "windows": True, "window_ticks": 4,
+                           "window_slots": 16, **kw}))
+    return eng, eng.run(n_ticks)
+
+
+@pytest.mark.parametrize("alg", ALL_ALGS)
+def test_sum_of_deltas_identity_per_plugin(alg):
+    # the tentpole identity: per-window int deltas telescope EXACTLY to
+    # the final cumulative counters, float columns latch the final
+    # value bit-equal, tick stamps land on the latch cadence — for the
+    # full derived vocabulary of every plugin (its private _cnt
+    # counters included)
+    eng, st = run_windowed(cc_alg=alg)
+    snap = eng.window_snapshot(st)
+    assert snap is not None and not obs_windows.wrapped(snap)
+    assert obs_windows.n_valid(snap) == 6          # 24 ticks / 4
+    assert obs_windows.reconcile(snap, eng.summary(st)) == []
+
+
+def test_off_path_is_byte_identical():
+    # windows off must be the EXACT seed engine: same summary keys, same
+    # values; windows on adds exactly the window_* bookkeeping keys and
+    # changes nothing else
+    off_eng = Engine(Config(**BASE))
+    off = off_eng.summary(off_eng.run(24))
+    on_eng, on_st = run_windowed()
+    on = on_eng.summary(on_st)
+    extra = set(on) - set(off)
+    assert extra == {"window_cnt", "window_wrapped", "window_slots",
+                     "window_ticks_per"}
+    for k in off:
+        assert on[k] == off[k], k
+    assert not any(k.startswith("arr_window")
+                   for k in off_eng.init_state().stats)
+
+
+def test_wrap_refuses_loudly():
+    # more windows latched than kept: reconcile must lead with the
+    # window_ring_wrapped finding instead of proving anything from a
+    # lossy ring
+    eng, st = run_windowed(window_slots=2)
+    snap = eng.window_snapshot(st)
+    assert obs_windows.wrapped(snap)
+    bad = obs_windows.reconcile(snap, eng.summary(st))
+    assert bad and bad[0][0] == "window_ring_wrapped"
+
+
+def test_latch_costs_zero_postwarm_recompiles():
+    # the latch is an unconditional scatter (OOB-drop on off ticks), so
+    # the traced tick is identical every tick: continuing a windowed run
+    # under the xmeter sentinel must hit the dispatch cache every call
+    eng = Engine(Config(**BASE, windows=True, window_ticks=4,
+                        window_slots=16, xmeter=True))
+    st = eng.run(12)
+    eng.xmeter.mark_warm()
+    st = eng.run(12, st)
+    assert eng.xmeter.steady_violations() == []
+    snap = eng.window_snapshot(st)
+    assert obs_windows.reconcile(snap, eng.summary(st)) == []
+
+
+def test_record_extra_round_trips_through_diff():
+    # the run-record "windows" block is what obs/diff.py segments: the
+    # two phase pseudo-summaries must add back to the cumulative
+    # counters (the identity, applied to the JSON form)
+    from deneva_tpu.obs import diff as obs_diff
+    eng, st = run_windowed()
+    extra = obs_windows.record_extra(eng.cfg, st.stats, st.db)
+    rec = {"summary": eng.summary(st), **extra}
+    sa, sb, split = obs_diff.segment_summaries(rec)
+    assert split == 12
+    snap = eng.window_snapshot(st)
+    for k, fin in snap["final_i"].items():
+        assert sa.get(k, 0) + sb.get(k, 0) == fin, k
+
+
+@pytest.mark.slow
+def test_sharded_identity_and_cluster_plane():
+    # sharded: each node latches its own ring inside the shard_map body;
+    # the host snapshot psum-merges the node axis and the identity must
+    # hold against the CLUSTER summary; the device psum plane must be
+    # bit-equal to the host sum
+    from deneva_tpu.parallel.sharded import ShardedEngine
+    cfg = Config(node_cnt=4, part_cnt=4, batch_size=32,
+                 synth_table_size=1 << 12, req_per_query=4,
+                 query_pool_size=1 << 10, zipf_theta=0.6,
+                 tup_read_perc=0.5, warmup_ticks=0, mpr=1.0,
+                 part_per_txn=4, mesh=True, windows=True,
+                 window_ticks=4, window_slots=16)
+    eng = ShardedEngine(cfg)
+    st = eng.run(16)
+    snap = eng.window_snapshot(st)
+    assert snap["nodes"] == 4
+    assert obs_windows.reconcile(snap, eng.summary(st)) == []
+    plane = np.asarray(eng.window_cluster_plane(st))
+    host = np.asarray(st.stats["arr_window_i32"], np.int64).sum(axis=0)
+    # the device psum merges the node axis on device; it must be
+    # bit-equal to the host-side sum of the stacked per-node rings
+    assert np.array_equal(plane.astype(np.int64), host)
