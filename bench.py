@@ -70,6 +70,17 @@ traffic matrix, Jain imbalance and remote-ratio that explain it.
 Writes ``scaling_grid.json``; EXPERIMENTS.md ("Diagnosing the flat MAAT
 scaling curve") reads it.
 
+With ``--depgraph`` the script runs the conflict dependency observatory
+sweep (Config.depgraph, deneva_tpu/obs/depgraph.py): each CC algorithm's
+small observed cell with the device-resident wait-for graph on — every
+plugin emits WHO blocked each waiter/victim, the engine samples
+(waiter, blocker, key, reason, tick) edges into a keep-last ring and
+keeps exact per-tick chain-depth/convoy planes — then reconciles the
+edge counters exactly against the twopl_wait integral and the abort
+taxonomy, detects cycles, decomposes commit critical paths against the
+flight spans, and appends per-alg chain-depth cells that feed the
+inverted obs/regress.py ceiling.
+
 Every headline run additionally APPENDS one JSON line to
 ``<out-dir>/bench_history.jsonl`` (unix time, git commit, config
 fingerprint, headline value, per-algorithm cells) — the trajectory that
@@ -1005,6 +1016,133 @@ def run_flight(args, out_dir: str = "results", history: bool = True) -> int:
     return code
 
 
+def run_depgraph(args, out_dir: str = "results",
+                 history: bool = True) -> int:
+    """--depgraph: conflict dependency observatory sweep
+    (Config.depgraph, obs/depgraph.py).
+
+    Runs each CC algorithm's small observed cell with the device-resident
+    wait-for graph on (blocker attribution from every plugin, sampled
+    edge ring, exact per-tick chain-depth/convoy planes) plus the flight
+    recorder, then:
+
+    - checks the exactness contract (obs_depgraph.reconcile): total wait
+      edges == the twopl_wait integral, abort edges partition exactly
+      into the abort taxonomy, ring rows per reason == the taxonomy
+      counters, and the per-partition plane sums to the edge total — a
+      wrapped ring refuses loudly instead of reconciling approximately;
+    - runs host-side cycle detection and the commit critical-path
+      decomposition (longest blocking chain behind each sampled commit,
+      joined against the flight spans) and prints the ``[depgraph]``
+      report section;
+    - writes one run record per algorithm with the snapshot under the
+      top-level ``"depgraph"`` key (``python -m deneva_tpu.obs.export``
+      merges the blocker->waiter flow arrows into the span timeline);
+    - appends a ``depgraph_chain`` record to the bench history: per-alg
+      peak chain depth / mean convoy width / cycle rate.  The per-alg
+      ``max_chain_depth`` feeds the self-arming INVERTED obs/regress.py
+      ceiling (chains lengthening = regression).
+
+    Exit code: 0 clean, 1 on any reconciliation mismatch OR a
+    post-warm recompile (the run is split warmup/steady under the
+    obs/xmeter.py sentinel — the plane's unconditional OOB-drop
+    scatters must never retrace); watchdog bits ride along, CONVOY=256
+    masked out — a convoy on the contended smoke cell is the expected
+    finding, not a failure."""
+    from deneva_tpu.obs import depgraph as obs_depgraph
+    from deneva_tpu.obs import flight as obs_flight
+    from deneva_tpu.obs import report as obs_report
+    alg_list = (list(_ALGS) if args.algs == "all"
+                else [a.strip().upper() for a in args.algs.split(",") if a])
+    # the observed cell at zipf 0.9 (not OBS_KW's 0.8): wait chains and
+    # convoys are the whole point of this sweep, and the hotter skew is
+    # what EXPERIMENTS.md profiles
+    dep_kw = {**OBS_KW, "zipf_theta": 0.9}
+    code = 0
+    algs_hist = {}
+    rec_paths = []
+    for alg in alg_list:
+        cfg = Config(cc_alg=alg, depgraph=True, flight=True,
+                     abort_attribution=True, dep_samples=1 << 15,
+                     flight_samples=1 << 14, trace_ticks=args.ticks,
+                     xmeter=True, **dep_kw)
+        eng = Engine(cfg)
+        t0 = time.perf_counter()
+        # warmup half / steady half under the recompile sentinel: the
+        # observatory's scatters are unconditional OOB-drop, so a
+        # steady-state recompile means the dead-lane discipline broke
+        state = eng.run(args.ticks // 2)
+        eng.xmeter.mark_warm()
+        state = eng.run(args.ticks - args.ticks // 2, state)
+        wall = time.perf_counter() - t0
+        for v in eng.xmeter.steady_violations():
+            print(f"[depgraph] {alg} RECOMPILE {v['entry']}: "
+                  f"{v['signature']}")
+            code |= 1
+        summary = eng.summary(state, wall)
+        snap = obs_depgraph.snapshot(state)
+        fsnap = obs_flight.snapshot(state)
+        bad = obs_depgraph.reconcile(snap, summary)
+        for what, got, want in bad:
+            print(f"[depgraph] {alg} RECONCILE MISMATCH {what}: "
+                  f"got={got} want={want}")
+            code = 1
+        cyc = obs_depgraph.cycles(snap)
+        ticks = max(int(summary["measured_ticks"]), 1)
+        print(f"[depgraph] {alg}: {snap['edge_cnt']} edges sampled "
+              f"({summary['dep_wait_edge_cnt']} wait / "
+              f"{summary['dep_abort_edge_cnt']} abort exact), "
+              f"{len(cyc)} cycle(s), "
+              f"reconcile {'MISMATCH' if bad else 'exact'}")
+        rep = obs_report.build_report(
+            summary, timeline=obs_trace.timeline(state),
+            flight=fsnap, depgraph=snap)
+        print(obs_report.render_text(rep))
+        # CONVOY (256) is the expected finding on this contended cell —
+        # the sweep measures it, the regress ceiling gates it
+        code |= rep["watchdog"]["exit_code"] & ~obs_report.CONVOY
+        rec = obs_profiler.run_record(
+            cfg, summary, timeline=obs_trace.timeline(state),
+            extra={"wall_seconds": wall, "flight": fsnap,
+                   "depgraph": snap})
+        rec_paths.append(obs_profiler.write_run_record(
+            rec, out_dir=out_dir,
+            name=f"run_depgraph_{alg.lower()}.json"))
+        algs_hist[alg] = {
+            "max_chain_depth": int(summary["dep_peak_depth"]),
+            "peak_convoy": int(summary["dep_peak_convoy"]),
+            "mean_convoy": round(
+                summary["dep_convoy_width_sum"] / ticks, 2),
+            "cycle_rate": round(
+                len(cyc) / max(snap["edge_cnt"], 1), 5),
+            "wait_edges": int(summary["dep_wait_edge_cnt"]),
+        }
+    doc = {
+        "metric": "depgraph_chain",
+        "value": float(algs_hist.get(alg_list[0],
+                                     {}).get("max_chain_depth", 0)),
+        "unit": "peak_wait_chain_depth",
+        "ticks": args.ticks,
+        "depgraph_chain": algs_hist,
+        "note": "per-alg wait-for-graph profile on the small observed "
+                "cell at zipf 0.9 (OBS_KW shape, Config.depgraph): "
+                "peak chain depth "
+                "(pointer-doubled, exact), peak/mean convoy width, "
+                "cycle rate over sampled edges; max_chain_depth feeds "
+                "the inverted regress ceiling",
+    }
+    print(json.dumps(doc))
+    print(f"[depgraph] run records: {' '.join(rec_paths)}")
+    print(f"[depgraph] merge: python -m deneva_tpu.obs.export "
+          f"{' '.join(rec_paths)} -o {out_dir}/depgraph_trace.json")
+    if history:
+        _append_history(doc, Config(cc_alg=alg_list[0], depgraph=True,
+                                    flight=True, abort_attribution=True,
+                                    **dep_kw),
+                        out_dir)
+    return code
+
+
 # the contended adaptive-controller cell (--adaptive): zipf 0.9 on a
 # small table at a batch big enough that the acceptance shape (B >= 2048)
 # holds on CPU; admit_cap keeps steady-state in-flight pressure high but
@@ -1171,8 +1309,12 @@ def _append_history(doc: dict, cfg: Config, out_dir: str = "results") -> str:
     # --serve records ride the same way: the per-family p99 dict keys a
     # distinct "serve_slo" trajectory with a self-arming CEILING (lower
     # is better) in obs/regress.py
+    # --depgraph records ride the same way: the per-alg chain cells key
+    # a distinct "depgraph_chain" trajectory with a self-arming inverted
+    # max-chain-depth CEILING in obs/regress.py
     for k in ("offered_load", "knee", "nodes", "batch_shapes",
-              "scaling_grid", "adaptive_vs_static", "slo_p99"):
+              "scaling_grid", "adaptive_vs_static", "slo_p99",
+              "depgraph_chain"):
         if k in doc:
             rec[k] = doc[k]
     os.makedirs(out_dir, exist_ok=True)
@@ -1417,6 +1559,14 @@ def _cli():
                         "abort reconciliation, [tail] p99 attribution, "
                         "per-alg run records for obs.export (exit 1 on "
                         "any reconcile mismatch)")
+    p.add_argument("--depgraph", action="store_true",
+                   help="conflict dependency observatory sweep: per-alg "
+                        "device-resident wait-for graph with exact edge "
+                        "reconciliation, cycle detection, commit "
+                        "critical paths and the [depgraph] report; "
+                        "appends per-alg chain-depth cells to the "
+                        "history for the inverted regress ceiling "
+                        "(exit 1 on any reconcile mismatch)")
     p.add_argument("--xmeter", action="store_true",
                    help="compile & memory observatory smoke: recompile "
                         "sentinel + ledger reconcile + roofline "
@@ -1477,6 +1627,9 @@ if __name__ == "__main__":
     if _args.flight:
         raise SystemExit(run_flight(_args, out_dir=_args.out_dir,
                                     history=not _args.no_history))
+    if _args.depgraph:
+        raise SystemExit(run_depgraph(_args, out_dir=_args.out_dir,
+                                      history=not _args.no_history))
     if _args.xmeter:
         raise SystemExit(run_xmeter(_args))
     if _args.trace or _args.profile or _args.prog_interval \

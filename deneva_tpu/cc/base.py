@@ -234,12 +234,24 @@ class AccessDecision(NamedTuple):
     ``reason`` — optional abort attribution (same shape, int32 REASON
     codes, meaningful where ``abort``): None whenever the config leaves
     ``abort_attribution`` off, so the default decision pytree keeps its
-    3-leaf contract shape (None contributes no leaf)."""
+    3-leaf contract shape (None contributes no leaf).
+
+    ``blocker`` — optional blocker identity for the dependency
+    observatory (same shape, int32 BLOCKER SLOT + 1, 0 = no identified
+    blocker, meaningful where ``wait`` or ``abort``): the txn slot whose
+    held lock / pending write / validated range caused this decision.
+    The +1 wire encoding survives the zero-fill of compaction spill
+    lanes and expand_entries (a spilled lane's synthesized retry has no
+    single blocker — 0 is the honest value).  None whenever
+    ``Config.depgraph`` is off, keeping the certified off-path pytree
+    byte-identical.  Presence is static per (plugin, cfg), like
+    ``reason``."""
 
     grant: jnp.ndarray
     wait: jnp.ndarray
     abort: jnp.ndarray
     reason: jnp.ndarray | None = None
+    blocker: jnp.ndarray | None = None
 
 
 class CCPlugin:

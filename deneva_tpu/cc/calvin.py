@@ -65,8 +65,16 @@ class Calvin(CCPlugin):
         ent = make_entries(txn, active, read_locks_held=True, window=R)
         db, ac = ccompact.compact_access(cfg, db, ent, B, R,
                                          request_all=True)
-        g, w, a = twopl.arbitrate(ac.ent, "CALVIN")
+        if cfg.depgraph:
+            # blocker = the epoch predecessor in the row's FIFO order
+            # (the txn whose unfinished frontier position delays mine)
+            g, w, a, blk = twopl.arbitrate(ac.ent, "CALVIN",
+                                           want_blocker=True)
+            blk = ccompact.finish_blocker(ac, blk).reshape(B, R)
+        else:
+            g, w, a = twopl.arbitrate(ac.ent, "CALVIN")
+            blk = None
         g, w, a = ccompact.finish_access(ac, ent.req, g, w, a,
                                          never_aborts=True)
         return AccessDecision(grant=g.reshape(B, R), wait=w.reshape(B, R),
-                              abort=a.reshape(B, R)), db
+                              abort=a.reshape(B, R), blocker=blk), db

@@ -175,3 +175,18 @@ def finish_reason(ac: AccessCompaction, req_e: jnp.ndarray,
                            jnp.int32(cc_base.REASON["compact_spill"]),
                            reason)
     return reason
+
+
+def finish_blocker(ac: AccessCompaction, blocker):
+    """Expand a width-K blocker plane (AccessDecision.blocker, slot+1
+    encoding) the same way ``finish_access`` expands its masks.  A
+    spill-forced retry and an ``unsafe`` all-WAIT stall have no single
+    blocker, so their lanes carry 0 (= none) — which is also what the
+    zero-fill of ``expand_entries`` gives every spilled/dead lane, so
+    only the unsafe stall needs an explicit mask.  None (Config.depgraph
+    off) passes through."""
+    # lint: disable-next=TRACED-BRANCH is-None STRUCTURE check: blocker is None iff depgraph is off (static per config), never a traced-value branch
+    if blocker is None:
+        return None
+    (blocker,) = seg.expand_entries(ac.view, blocker)
+    return jnp.where(ac.unsafe, 0, blocker)

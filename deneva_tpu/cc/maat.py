@@ -195,7 +195,12 @@ class Maat(CCPlugin):
         gr = jnp.maximum(db["maat_gr"], lr_k.max(axis=1))
 
         z = jnp.zeros((B, R), dtype=bool)
-        return (AccessDecision(grant=req, wait=z, abort=z),
+        # MAAT never waits or aborts at access (ranges only tighten), so
+        # no wait edges exist; a range collapse is squeezed by potentially
+        # MANY neighbors' pushes, so vabort edges carry no single blocker
+        # either (depgraph documents MAAT chains as depth 0 by design)
+        zb = jnp.zeros((B, R), jnp.int32) if cfg.depgraph else None
+        return (AccessDecision(grant=req, wait=z, abort=z, blocker=zb),
                 {**db, "maat_gw": gw, "maat_gr": gr})
 
     def remote_cache_probe(self, cfg: Config, db: dict, keys, iw, live):

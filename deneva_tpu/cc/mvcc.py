@@ -154,11 +154,12 @@ class Mvcc(CCPlugin):
         c = ac.ent
         w_ab_c, evict_c, v_ts_c = ac.extras
         nK = c.key.shape[0]
-        (skey, sts), (s_iw, s_held, s_req, s_wab, s_orig) = seg.sort_by(
-            (c.key, c.ts),
-            (c.is_write, c.held, c.req, w_ab_c,
-             jnp.arange(nK, dtype=jnp.int32)),
-        )
+        orig = jnp.arange(nK, dtype=jnp.int32)
+        payload = (c.is_write, c.held, c.req, w_ab_c, orig)
+        if cfg.depgraph:
+            payload = payload + (c.txn,)
+        (skey, sts), spay = seg.sort_by((c.key, c.ts), payload)
+        s_iw, s_held, s_req, s_wab, s_orig = spay[:5]
         starts = seg.segment_starts(skey)
         live = skey != NULL_KEY
         pending_w = live & s_iw & (s_held | (s_req & ~s_wab))
@@ -173,6 +174,21 @@ class Mvcc(CCPlugin):
                                     ~r_abort & ~r_wait)
         wait_e = c.req & ~c.is_write & ~r_abort & r_wait
         abort_e = c.req & ~grant_e & ~wait_e
+        blk = None
+        if cfg.depgraph:
+            # blocker of a conflict()-WAITING read: the nearest preceding
+            # pending prewrite in ts order — the largest-ts prewriter
+            # below me, exactly the `pts` the wait rule tested.  Aborts
+            # (version evicted / observed by a later committed read) are
+            # against history, not a live txn: 0.
+            s_slot = spay[5]
+            lane = jnp.arange(nK, dtype=jnp.int32)
+            blane = seg.seg_prefix_max(jnp.where(pending_w, lane, -1),
+                                       starts, identity=-1)
+            blk_s = jnp.where(blane >= 0,
+                              s_slot[jnp.clip(blane, 0)] + 1, 0)
+            blk = jnp.where(wait_e, seg.unpermute(s_orig, blk_s), 0)
+            blk = ccompact.finish_blocker(ac, blk).reshape(B, R)
         reason = static_reason(cfg, self.access_abort_reasons[0],
                                abort_e.shape)
         grant_e, wait_e, abort_e = ccompact.finish_access(
@@ -195,7 +211,8 @@ class Mvcc(CCPlugin):
                                wait=wait_e.reshape(B, R),
                                abort=abort_e.reshape(B, R),
                                reason=None if reason is None
-                               else reason.reshape(B, R)),
+                               else reason.reshape(B, R),
+                               blocker=blk),
                 {**db, "r_ring": r_ring, "rts0": rts0})
 
     def on_commit(self, cfg: Config, db: dict, txn: TxnState, committed,

@@ -61,22 +61,30 @@ class TwoPLPlugin(CCPlugin):
             # (Config.sub_ticks; SURVEY.md §7 within-batch ordering);
             # NOLOCK / READ_UNCOMMITTED take their bypass paths below
             assert cfg.acquire_window == 1, "sub_ticks needs window=1"
-            g, w, a = twopl.arbitrate_subticked(
+            out = twopl.arbitrate_subticked(
                 txn, active, self.policy, cfg.sub_ticks,
                 read_locks_held=(cfg.isolation_level == SERIALIZABLE),
-                pipelined=cfg.pipeline_exchange)
+                pipelined=cfg.pipeline_exchange,
+                want_blocker=cfg.depgraph)
+            g, w, a = out[:3]
             return AccessDecision(
                 grant=g, wait=w, abort=a,
                 reason=static_reason(cfg, self.access_abort_reasons[0],
-                                     (B, R))), db
+                                     (B, R)),
+                blocker=out[3] if cfg.depgraph else None), db
         if self._window_path(cfg):
             g, w, a, tmp = twopl.arbitrate_window(
                 txn, active, self.policy, db, cfg.acquire_window,
                 read_locks_held=(cfg.isolation_level != READ_COMMITTED))
+            # the dense scratch packs holder TS, not slot identity: the
+            # window kernel emits no blockers (counts stay exact — the
+            # engine's edge counters key on the wait/abort masks alone)
+            blk = jnp.zeros((B, R), jnp.int32) if cfg.depgraph else None
             return AccessDecision(
                 grant=g, wait=w, abort=a,
                 reason=static_reason(cfg, self.access_abort_reasons[0],
-                                     (B, R))), {**db, **tmp}
+                                     (B, R)),
+                blocker=blk), {**db, **tmp}
 
         ent = make_entries(
             txn, active,
@@ -84,10 +92,11 @@ class TwoPLPlugin(CCPlugin):
                                                          READ_UNCOMMITTED)),
             window=cfg.acquire_window)
         z = jnp.zeros((B, R), dtype=bool)
+        zb = jnp.zeros((B, R), jnp.int32) if cfg.depgraph else None
 
         if cfg.isolation_level == NOLOCK:
             return AccessDecision(grant=ent.req.reshape(B, R), wait=z,
-                                  abort=z), db
+                                  abort=z, blocker=zb), db
 
         bypass = z
         if cfg.isolation_level == READ_UNCOMMITTED:
@@ -101,7 +110,13 @@ class TwoPLPlugin(CCPlugin):
         # spilled retryable lanes abort-and-retry, counted in
         # compact_overflow_cnt (cc/compact.py)
         db, ac = ccompact.compact_access(cfg, db, ent, B, R)
-        g, w, a = twopl.arbitrate(ac.ent, self.policy)
+        if cfg.depgraph:
+            g, w, a, blk = twopl.arbitrate(ac.ent, self.policy,
+                                           want_blocker=True)
+            blk = ccompact.finish_blocker(ac, blk).reshape(B, R)
+        else:
+            g, w, a = twopl.arbitrate(ac.ent, self.policy)
+            blk = None
         reason = static_reason(cfg, self.access_abort_reasons[0], a.shape)
         g, w, a = ccompact.finish_access(ac, ent.req, g, w, a)
         reason = ccompact.finish_reason(ac, ent.req, reason)
@@ -111,7 +126,7 @@ class TwoPLPlugin(CCPlugin):
         return AccessDecision(grant=g.reshape(B, R) | bypass,
                               wait=w.reshape(B, R),
                               abort=a.reshape(B, R),
-                              reason=reason), db
+                              reason=reason, blocker=blk), db
 
 
 class NoWait(TwoPLPlugin):

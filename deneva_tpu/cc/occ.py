@@ -54,6 +54,12 @@ class Occ(CCPlugin):
               # active-set conflicts; warmup-gated, surfaced in [summary]
               "occ_hist_abort_cnt": jnp.zeros((), jnp.int32),
               "occ_active_abort_cnt": jnp.zeros((), jnp.int32)}
+        if cfg.depgraph:
+            # validation victim of the last active-set failure per slot
+            # (txn slot, -1 = none): the earlier same-tick valid writer
+            # the failed validator lost to.  The engine reads this at its
+            # vabort note_aborts site (dependency observatory edges).
+            db["dep_vblocker"] = jnp.full(B, -1, jnp.int32)
         if cfg.net_delay_ticks > 0:
             # prepare-phase reservation (net_delay mode): a yes-voted
             # validator's writes block later validators until its delayed
@@ -98,12 +104,16 @@ class Occ(CCPlugin):
         return {**db, "occ_prep": prep}
 
     def access(self, cfg: Config, db: dict, txn: TxnState, active):
-        # optimistic work phase: every access proceeds immediately
+        # optimistic work phase: every access proceeds immediately — no
+        # wait edges exist for OCC by construction (the depgraph blocker
+        # plane is structurally present but always "none"; validation
+        # victims surface through dep_vblocker at vabort time instead)
         B, R = txn.keys.shape
         req = make_entries(txn, active,
                            window=cfg.acquire_window).req.reshape(B, R)
         z = jnp.zeros((B, R), dtype=bool)
-        return AccessDecision(grant=req, wait=z, abort=z), db
+        zb = jnp.zeros((B, R), jnp.int32) if cfg.depgraph else None
+        return AccessDecision(grant=req, wait=z, abort=z, blocker=zb), db
 
     def validate(self, cfg: Config, db: dict, txn: TxnState, finishing, tick):
         B, R = txn.keys.shape
@@ -280,6 +290,27 @@ class Occ(CCPlugin):
         valid0 = group_and(pass1) if group_and is not None else pass1
         valid, _ = jax.lax.while_loop(
             lambda c: c[1], step, (valid0, jnp.any(pass1) | True))
+        if "dep_vblocker" in db:
+            # validation victim (Config.depgraph): with the fixed point
+            # settled, a failed validator's blocker is the nearest earlier
+            # VALID writer lane in its row segment — the same "blocking"
+            # predicate the loop converged on, read once more to recover
+            # identity instead of just existence
+            valid_e = valid[jnp.clip(tx, 0, B - 1)]
+            _, _, s_valid = seg.sort_pack(
+                (key, ts, valid_e.astype(jnp.int32)), num_keys=2,
+                is_stable=False)
+            blocking = live & s_iw & (s_valid == 1)
+            lane = jnp.arange(skey.shape[0], dtype=jnp.int32)
+            blane = seg.seg_prefix_max(jnp.where(blocking, lane, -1),
+                                       starts, identity=-1)
+            bat = seg.at_run_start(blane, run_start, starts, -1, "max")
+            has_b = live & (bat >= 0)
+            vb = jnp.full(B, -1, jnp.int32).at[
+                jnp.where(has_b, s_tx, B)].max(
+                s_tx[jnp.clip(bat, 0)], mode="drop")
+            db = {**db,
+                  "dep_vblocker": jnp.where(pass1 & ~valid, vb, -1)}
         measuring = tick >= cfg.warmup_ticks
         cnt = lambda m: jnp.where(measuring,
                                   jnp.sum(m.astype(jnp.int32)), 0)

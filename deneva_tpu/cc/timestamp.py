@@ -49,16 +49,27 @@ from deneva_tpu.engine.state import (NULL_KEY, TxnState, contract_window,
 from deneva_tpu.ops import segment as seg
 
 
-def _decide(key, ts, is_write, held, req, w_abort, r_abort):
+def _decide(key, ts, is_write, held, req, w_abort, r_abort,
+            txn_slot=None):
     """The per-request T/O decision over flat entry arrays: sorts by
     (key, ts), finds the pending-prewrite prefix ("a write entry — held
     prewrite, or prewrite granted earlier this tick — with smaller ts
     exists on my key"), and applies the grant/wait/abort rules.  The one
-    shared body behind both the one-round and sub-ticked paths."""
+    shared body behind both the one-round and sub-ticked paths.
+
+    ``txn_slot`` (Config.depgraph) threads per-lane txn slots through the
+    sort and appends a blocker plane (slot + 1, 0 = none): a WAITING read
+    points at the nearest preceding pending prewrite in ts order — the
+    conflicting writer whose commit/abort will unblock it.  T/O aborts
+    are against already-committed history (wts/rts), not a live txn, so
+    abort lanes carry 0."""
     n = key.shape[0]
-    (skey, sts), (s_iw, s_held, s_req, s_wab, s_orig) = seg.sort_by(
-        (key, ts),
-        (is_write, held, req, w_abort, jnp.arange(n, dtype=jnp.int32)))
+    orig = jnp.arange(n, dtype=jnp.int32)
+    payload = (is_write, held, req, w_abort, orig)
+    if txn_slot is not None:
+        payload = payload + (txn_slot,)
+    (skey, sts), spay = seg.sort_by((key, ts), payload)
+    s_iw, s_held, s_req, s_wab, s_orig = spay[:5]
     starts = seg.segment_starts(skey)
     live = skey != NULL_KEY
     pending_w = live & s_iw & (s_held | (s_req & ~s_wab))
@@ -68,7 +79,15 @@ def _decide(key, ts, is_write, held, req, w_abort, r_abort):
     grant = req & jnp.where(is_write, ~w_abort, ~r_abort & ~pw)
     wait = req & ~is_write & ~r_abort & pw
     abort = req & ~grant & ~wait
-    return grant, wait, abort
+    if txn_slot is None:
+        return grant, wait, abort
+    s_slot = spay[5]
+    lane = jnp.arange(n, dtype=jnp.int32)
+    blane = seg.seg_prefix_max(jnp.where(pending_w, lane, -1), starts,
+                               identity=-1)
+    blk_s = jnp.where(blane >= 0, s_slot[jnp.clip(blane, 0)] + 1, 0)
+    blocker = jnp.where(wait, seg.unpermute(s_orig, blk_s), 0)
+    return grant, wait, abort, blocker
 
 
 def _rw_reason(cfg, is_write):
@@ -129,9 +148,16 @@ class Timestamp(CCPlugin):
         # (cc/compact.py class discipline)
         db, ac = ccompact.compact_access(cfg, db, ent, B, R,
                                          extras=(w_abort, r_abort))
-        grant_e, wait_e, abort_e = _decide(
-            ac.ent.key, ac.ent.ts, ac.ent.is_write, ac.ent.held, ac.ent.req,
-            *ac.extras)
+        if cfg.depgraph:
+            grant_e, wait_e, abort_e, blk = _decide(
+                ac.ent.key, ac.ent.ts, ac.ent.is_write, ac.ent.held,
+                ac.ent.req, *ac.extras, txn_slot=ac.ent.txn)
+            blk = ccompact.finish_blocker(ac, blk).reshape(B, R)
+        else:
+            grant_e, wait_e, abort_e = _decide(
+                ac.ent.key, ac.ent.ts, ac.ent.is_write, ac.ent.held,
+                ac.ent.req, *ac.extras)
+            blk = None
         reason = _rw_reason(cfg, ac.ent.is_write)
         grant_e, wait_e, abort_e = ccompact.finish_access(
             ac, ent.req, grant_e, wait_e, abort_e)
@@ -149,7 +175,8 @@ class Timestamp(CCPlugin):
                                wait=wait_e.reshape(B, R),
                                abort=abort_e.reshape(B, R),
                                reason=None if reason is None
-                               else reason.reshape(B, R)),
+                               else reason.reshape(B, R),
+                               blocker=blk),
                 {**db, "rts": rts})
 
     def _access_subticked(self, cfg: Config, db: dict, txn: TxnState,
@@ -188,18 +215,29 @@ class Timestamp(CCPlugin):
         G = jnp.zeros((B, R), dtype=bool)
         Wt = jnp.zeros((B, R), dtype=bool)
         A = jnp.zeros((B, R), dtype=bool)
+        BLK = jnp.zeros((B, R), dtype=jnp.int32)
         dead = jnp.zeros(B, dtype=bool)
         flat = lambda x: x.reshape(-1)
         n = B * R
+        slot_e = jnp.broadcast_to(
+            jnp.arange(B, dtype=jnp.int32)[:, None], (B, R))
         for k in range(K):
             grp = active & (group == k) & ~dead
             req_m = req_base & grp[:, None]
             held_m = (held_base | G) & ~dead[:, None]
             live = held_m | req_m
             key_f = jnp.where(flat(live), flat(txn.keys), NULL_KEY)
-            g, w, a = _decide(key_f, flat(ts_e), flat(txn.is_write),
-                              flat(held_m), flat(req_m), flat(w_abort),
-                              flat(r_abort))
+            if cfg.depgraph:
+                g, w, a, blk = _decide(key_f, flat(ts_e),
+                                       flat(txn.is_write), flat(held_m),
+                                       flat(req_m), flat(w_abort),
+                                       flat(r_abort),
+                                       txn_slot=flat(slot_e))
+                BLK = jnp.maximum(BLK, blk.reshape(B, R))
+            else:
+                g, w, a = _decide(key_f, flat(ts_e), flat(txn.is_write),
+                                  flat(held_m), flat(req_m), flat(w_abort),
+                                  flat(r_abort))
             g, w, a = (g.reshape(B, R), w.reshape(B, R), a.reshape(B, R))
             G, Wt, A = G | g, Wt | w, A | a
             dead = dead | a.any(axis=1)
@@ -207,7 +245,8 @@ class Timestamp(CCPlugin):
         rts = db["rts"].at[flat(txn.keys)].max(
             jnp.where(flat(G & ~txn.is_write), flat(ts_e), 0), mode="drop")
         return (AccessDecision(grant=G, wait=Wt, abort=A,
-                               reason=_rw_reason(cfg, txn.is_write)),
+                               reason=_rw_reason(cfg, txn.is_write),
+                               blocker=BLK if cfg.depgraph else None),
                 {**db, "rts": rts})
 
     def on_commit(self, cfg: Config, db: dict, txn: TxnState, committed,

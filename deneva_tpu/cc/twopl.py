@@ -48,11 +48,19 @@ _IDX_MASK = (1 << _IDX_BITS) - 1
 _DEAD_ROW = (1 << 30) - 1
 
 
-def arbitrate(ent: Entries, policy: str):
+def arbitrate(ent: Entries, policy: str, want_blocker: bool = False):
     """Resolve this tick's lock requests.
 
     Returns (grant, wait, abort): (B*R,) masks in original entry order,
     true only at request positions.
+
+    ``want_blocker`` (Config.depgraph) appends a fourth (B*R,) int32
+    array: the blocker identity of every failed request, encoded as
+    blocker txn slot + 1 (0 = none).  A failed WRITE points at its
+    immediate predecessor in the row's (held-first, ts) segment order —
+    so a writer convoy reads back as a depth ladder 1..k, not k
+    independent depth-1 waits — and a failed READ points at the nearest
+    preceding write entry that actually blocks it under the policy.
     """
     n = ent.key.shape[0]
     assert n <= 1 << _IDX_BITS, n
@@ -79,7 +87,8 @@ def arbitrate(ent: Entries, policy: str):
 
     if policy == "CALVIN":
         # FIFO: any write earlier in the segment (granted or not) blocks.
-        any_w_before = seg.seg_any_before(s_iw & s_live, starts)
+        w_blocks = s_iw & s_live
+        any_w_before = seg.seg_any_before(w_blocks, starts)
         s_grant = s_req & jnp.where(s_iw, pos == 0, ~any_w_before)
         s_wait = s_req & ~s_grant
         s_abort = jnp.zeros_like(s_grant)
@@ -88,8 +97,8 @@ def arbitrate(ent: Entries, policy: str):
         # is also necessarily at position 0 (exclusive => sole live entry
         # apart from this tick's requests).  So "conflicting lock earlier in
         # order" == "a write at pos 0 or a held write before me".
-        eff_w_before = seg.seg_any_before(
-            s_iw & s_live & (s_held | (pos == 0)), starts)
+        w_blocks = s_iw & s_live & (s_held | (pos == 0))
+        eff_w_before = seg.seg_any_before(w_blocks, starts)
         s_grant = s_req & jnp.where(s_iw, pos == 0, ~eff_w_before)
         s_fail = s_req & ~s_grant
         if policy == "NO_WAIT":
@@ -106,8 +115,31 @@ def arbitrate(ent: Entries, policy: str):
 
     packed = (s_grant.astype(jnp.int32) | (s_wait.astype(jnp.int32) << 1)
               | (s_abort.astype(jnp.int32) << 2))
-    out = seg.unpermute(s_idx, packed)
-    return out & 1 == 1, (out >> 1) & 1 == 1, (out >> 2) & 1 == 1
+    if not want_blocker:
+        out = seg.unpermute(s_idx, packed)
+        return out & 1 == 1, (out >> 1) & 1 == 1, (out >> 2) & 1 == 1
+
+    # blocker attribution (Config.depgraph): the nearest earlier segment
+    # lane responsible for this failure.  A failed WRITE needs pos == 0,
+    # so ANY earlier live lane blocks it — its immediate predecessor
+    # makes writer convoys read back as depth ladders; a failed READ is
+    # blocked specifically by the nearest earlier blocking-write lane
+    # (w_blocks above matches each policy's grant rule).  The exclusive
+    # segmented prefix-max of the lane index finds both; the txn-slot
+    # gather only runs on this opted-in path.
+    lane = jnp.arange(n, dtype=jnp.int32)
+    s_fail = s_req & ~s_grant
+    prev_any = seg.seg_prefix_max(jnp.where(s_live, lane, -1), starts,
+                                  identity=-1)
+    prev_w = seg.seg_prefix_max(jnp.where(w_blocks, lane, -1), starts,
+                                identity=-1)
+    blane = jnp.where(s_iw, prev_any, prev_w)
+    s_txn = ent.txn[s_idx]
+    blk1 = jnp.where(s_fail & (blane >= 0),
+                     s_txn[jnp.clip(blane, 0)] + 1, 0)
+    out, blk = seg.unpermute_many(s_idx, packed, blk1)
+    return (out & 1 == 1, (out >> 1) & 1 == 1, (out >> 2) & 1 == 1,
+            blk)
 
 
 # ---------------------------------------------------------------------------
@@ -311,7 +343,8 @@ def ts_groups(ts, active, K: int):
 
 def arbitrate_subticked(txn, active, policy: str, K: int,
                         read_locks_held: bool = True,
-                        pipelined: bool = False):
+                        pipelined: bool = False,
+                        want_blocker: bool = False):
     """Arbitrate one tick's requests in K timestamp-ordered sub-rounds.
 
     The one-round tick decides all requests against the tick-START lock
@@ -353,6 +386,7 @@ def arbitrate_subticked(txn, active, policy: str, K: int,
     G = jnp.zeros((B, R), dtype=bool)
     W = jnp.zeros((B, R), dtype=bool)
     A = jnp.zeros((B, R), dtype=bool)
+    BLK = jnp.zeros((B, R), dtype=jnp.int32)
     dead = jnp.zeros(B, dtype=bool)
 
     flat = lambda x: x.reshape(-1)
@@ -379,8 +413,14 @@ def arbitrate_subticked(txn, active, policy: str, K: int,
             txn=flat(txe), ridx=flat(jnp.broadcast_to(ridx, (B, R))),
             ts=flat(tse), is_write=flat(txn.is_write),
             held=flat(held_m), req=flat(req_m))
-        g, w, a = arbitrate(ent, policy)
+        if want_blocker:
+            g, w, a, blk = arbitrate(ent, policy, want_blocker=True)
+            BLK = jnp.maximum(BLK, blk.reshape(B, R))
+        else:
+            g, w, a = arbitrate(ent, policy)
         g, w, a = g.reshape(B, R), w.reshape(B, R), a.reshape(B, R)
         G, W, A = G | g, W | w, A | a
         dead = dead | a.any(axis=1)
+    if want_blocker:
+        return G, W, A, BLK
     return G, W, A

@@ -673,6 +673,26 @@ class Config:
     #: windows trips the loud wrap refusal in obs/windows.reconcile
     window_slots: int = 64
 
+    #: conflict dependency observatory (deneva_tpu/obs/depgraph.py): every
+    #: CC plugin emits the BLOCKER identity alongside its decision
+    #: (AccessDecision.blocker) and the engine scatters sampled
+    #: (waiter, blocker, key, reason, tick) wait-for edges into a
+    #: keep-last device ring in the donated stats carry, plus exact
+    #: per-tick aggregate planes: wait-chain depth via blocker-pointer
+    #: doubling, convoy head width, per-partition edge counts.  Host side
+    #: reconciles under exact identities (wait edges == the twopl_wait
+    #: integral; abort edges partition into the abort taxonomy), detects
+    #: cycles over the sampled graph, and decomposes commit critical
+    #: paths against the flight recorder.  Requires abort_attribution
+    #: (edges carry taxonomy reason codes).  Off by default: zero extra
+    #: device arrays and a byte-identical [summary] line (certified).
+    depgraph: bool = _optin(False, {"depgraph": True,
+                                    "abort_attribution": True})
+    #: edge-ring capacity (sampled edges kept); reconciliation of edge
+    #: rows against the counters needs the ring unwrapped — size it to
+    #: the expected wait+abort volume or treat row-level views as samples
+    dep_samples: int = 1 << 12
+
     # --- run protocol (reference config.h:349-350: 60s warmup + 60s run) ---
     seed: int = 12345
     query_pool_size: int = 1 << 16    # pre-generated queries (client_query.cpp:30)
@@ -729,6 +749,18 @@ class Config:
             assert self.abort_attribution, \
                 "flight recorder requires abort_attribution"
             assert self.flight_samples > 0
+        if self.depgraph:
+            # abort edges carry taxonomy reason codes and the host-side
+            # reconciliation partitions them into the abort_* counters —
+            # the graph is meaningless without attribution
+            assert self.abort_attribution, \
+                "depgraph requires abort_attribution"
+            assert self.dep_samples > 0
+            # the epoch-split exchange decides grants from per-row
+            # aggregate planes without ever materializing a per-entry
+            # opponent — there is no blocker identity to ship home
+            assert not self.exchange_split, \
+                "depgraph is incompatible with exchange_split"
         # the conflict histogram hashes with a multiplicative shift, so
         # the bin count must be a power of two (obs: engine heatmap)
         assert self.heatmap_bins >= 0 and \

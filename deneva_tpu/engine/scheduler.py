@@ -35,6 +35,7 @@ from deneva_tpu import workloads as wl_registry
 from deneva_tpu.cc import base as cc_base
 from deneva_tpu.config import Config
 from deneva_tpu import traffic
+from deneva_tpu.obs import depgraph as obs_depgraph
 from deneva_tpu.obs import flight as obs_flight
 from deneva_tpu.obs import histo as obs_histo
 from deneva_tpu.obs import trace as obs_trace
@@ -140,6 +141,15 @@ def _zeros_stats(cfg: Config | None = None,
         # transaction flight recorder (obs/flight.py): per-slot open-span
         # columns + completed-span / abort-event keep-last rings
         s.update(obs_flight.init_flight(cfg))
+    if cfg is not None and cfg.depgraph:
+        # conflict dependency observatory (obs/depgraph.py): sampled
+        # wait-for edge ring, blocker-pointer plane, chain-depth /
+        # convoy / partition aggregates and the dep_* edge counters —
+        # bumped at EXACTLY the twopl_wait_cnt and note_aborts sites so
+        #   dep_wait_edge_cnt  == twopl_wait_cnt
+        #   dep_abort_edge_cnt == sum(abort_*_cnt)
+        # hold exactly for every plugin
+        s.update(obs_depgraph.init_depgraph(cfg))
     if cfg is not None and cfg.heatmap_bins > 0:
         # contention heatmap (Config.heatmap_bins): hashed per-key
         # conflict histogram + a representative key per bin, per-partition
@@ -284,7 +294,8 @@ def _reason_hist(code_b, mask_b):
 
 
 def note_aborts(cfg: Config, stats: dict, code_b, mask_b,
-                measuring, t=None, key_b=None) -> dict:
+                measuring, t=None, key_b=None, blocker_b=None,
+                node=0, cross_b=None) -> dict:
     """Bump the per-reason abort counters (and the tick's reason-trace
     accumulator, which is NOT warmup-gated) for one abort-event
     population.  Called at EXACTLY the sites that bump the aggregate
@@ -310,6 +321,28 @@ def note_aborts(cfg: Config, stats: dict, code_b, mask_b,
                  stats["arr_ctrl_reason_tick"] + hist}
     if t is not None:
         stats = obs_flight.record_events(stats, code_b, mask_b, t, key_b)
+    if t is not None and "arr_dep_ring" in stats:
+        # dependency observatory: one abort EDGE per event row, with the
+        # SAME masks and the same code normalization as the taxonomy
+        # counters above (including the vabort double-count), so
+        # dep_abort_edge_cnt == sum(abort_*_cnt) by construction.
+        # blocker_b is the victim slot where the caller knows one (2PL
+        # holder, TIMESTAMP/MVCC conflicting writer, OCC validation
+        # victim via db["dep_vblocker"]); -1 = conflict against
+        # committed history, no live opponent.
+        n_reg = len(cc_base.ABORT_REASONS)
+        code = jnp.where(code_b <= 0, jnp.int32(cc_base.REASON["other"]),
+                         code_b)
+        code = jnp.minimum(code, n_reg)
+        B = mask_b.shape[0]
+        # lint: disable-next=TRACED-BRANCH is-None STRUCTURE check: blocker_b/key_b are None iff the call site carries no blocker/key column (static per call site), never a traced-value branch
+        blk = blocker_b if blocker_b is not None \
+            else jnp.full((B,), -1, jnp.int32)
+        kb = key_b if key_b is not None \
+            else jnp.full((B,), NULL_KEY, jnp.int32)
+        stats = obs_depgraph.record_edges(
+            stats, "dep_abort_edge_cnt", mask_b, blk, kb, code, t,
+            measuring, node=node, cross_b=cross_b)
     return stats
 
 
@@ -528,6 +561,9 @@ def make_tick(cfg: Config, plugin, pool_dev: dict, workload=None):
         # DELTA of the cumulative note_compaction counters (cc/base.py)
         live_base = db.get("live_entry_cnt")
         ovf_base = db.get("compact_overflow_cnt")
+        # dependency-edge baseline: the trace row records this tick's
+        # DELTA of the cumulative edge-ring append count (obs/depgraph.py)
+        dep_base = stats.get("arr_dep_cnt")
         if "arr_reason_tick" in stats:
             # this tick's per-reason abort histogram, accumulated by
             # note_aborts and recorded into the reason-trace ring below
@@ -723,10 +759,13 @@ def make_tick(cfg: Config, plugin, pool_dev: dict, workload=None):
             stats = bump(stats, "user_abort_cnt",
                          jnp.sum(ua.astype(jnp.int32)), measuring)
             # reason taxonomy: one per-reason bump per aggregate bump
-            # above (vabort_cnt / user_abort_cnt), same masks
+            # above (vabort_cnt / user_abort_cnt), same masks; the OCC
+            # validation VICTIM (dep_vblocker, cc/occ.py) rides the
+            # vabort edge when the dependency observatory is on
             stats = note_aborts(cfg, stats,
                                 jnp.full((txn.B,), vabort_code, jnp.int32),
-                                vabort, measuring, t=t)
+                                vabort, measuring, t=t,
+                                blocker_b=db.get("dep_vblocker"))
             stats = note_aborts(cfg, stats,
                                 jnp.full((txn.B,), ua_code, jnp.int32),
                                 ua, measuring, t=t)
@@ -797,7 +836,13 @@ def make_tick(cfg: Config, plugin, pool_dev: dict, workload=None):
                         & (ridx < txn.cursor[:, None] + cfg.acquire_window)
                         & (ridx < txn.n_req[:, None]))
                 z = jnp.zeros_like(reqm)
-                dec = AccessDecision(grant=reqm, wait=z, abort=z)
+                # blocker plane present iff Config.depgraph, like every
+                # plugin path (decision STRUCTURE is static per config);
+                # the bypass modes grant everything, so all-zeros = none
+                dec = AccessDecision(
+                    grant=reqm, wait=z, abort=z,
+                    blocker=(jnp.zeros(reqm.shape, jnp.int32)
+                             if cfg.depgraph else None))
 
             # advance over the granted prefix; the wait/abort outcome is
             # the first non-granted requested access's decision
@@ -829,6 +874,21 @@ def make_tick(cfg: Config, plugin, pool_dev: dict, workload=None):
                 # so the masked sum is a gather-free row lookup
                 fail_key = jnp.sum(jnp.where(ridx == fail_pos, txn.keys, 0),
                                    axis=1)
+            dep_blk = None
+            if cfg.depgraph:
+                # blocker slot at the failing access (wire slot+1 -> -1 =
+                # none), meaningful wherever the lane waited or aborted.
+                # Wait EDGES record at the EXACT mask of the
+                # twopl_wait_cnt bump above (the identity
+                # dep_wait_edge_cnt == twopl_wait_cnt), then the
+                # blocker-pointer plane feeds the end-of-tick
+                # chain/convoy kernel (obs_depgraph.tick_planes).
+                dep_blk = jnp.max(jnp.where(ridx == fail_pos, dec.blocker,
+                                            0), axis=1) - 1
+                stats = obs_depgraph.record_edges(
+                    stats, "dep_wait_edge_cnt", wait, dep_blk,
+                    jnp.where(wait, fail_key, NULL_KEY), 0, t, measuring)
+                stats = obs_depgraph.note_waits(stats, wait, dep_blk)
             if cfg.abort_attribution:
                 # classify every abort event counted above: the plugin's
                 # reason code at the failing access (dec.reason is
@@ -849,10 +909,21 @@ def make_tick(cfg: Config, plugin, pool_dev: dict, workload=None):
                     acc_fail & reab,
                     jnp.int32(cc_base.REASON["backoff_reabort"]), code_b)
                 code_b = jnp.where(vabort, vabort_code, code_b)
+                dep_ab_blk = None
+                if cfg.depgraph:
+                    # abort-edge blockers: the access-failure victim at
+                    # fail_pos; vabort lanes (from a preceding commit
+                    # block) carry the OCC validation victim when the
+                    # plugin recovered one, else none
+                    vblk = db.get("dep_vblocker")
+                    dep_ab_blk = jnp.where(
+                        acc_fail, dep_blk,
+                        vblk if vblk is not None else -1)
                 stats = note_aborts(cfg, stats, code_b, abort_now,
                                     measuring, t=t,
                                     key_b=jnp.where(acc_fail, fail_key,
-                                                    NULL_KEY))
+                                                    NULL_KEY),
+                                    blocker_b=dep_ab_blk)
                 stats = note_last_abort(
                     stats, abort_now, code_b,
                     jnp.where(acc_fail, fail_key, NULL_KEY))
@@ -914,7 +985,8 @@ def make_tick(cfg: Config, plugin, pool_dev: dict, workload=None):
                          jnp.sum(vabort.astype(jnp.int32)), measuring)
             stats = note_aborts(cfg, stats,
                                 jnp.full((txn.B,), vabort_code, jnp.int32),
-                                vabort, measuring, t=t)
+                                vabort, measuring, t=t,
+                                blocker_b=db.get("dep_vblocker"))
             txn = txn._replace(
                 status=jnp.where(vabort, STATUS_BACKOFF, txn.status),
                 cursor=jnp.where(vabort, 0, txn.cursor),
@@ -941,6 +1013,12 @@ def make_tick(cfg: Config, plugin, pool_dev: dict, workload=None):
         stats = track_state_latencies(stats, txn, measuring)
         # flight recorder: per-slot mirror of the same masks + gate
         stats = obs_flight.track_phases(stats, txn, t, measuring)
+        dep_dmax = dep_conv = jnp.int32(0)
+        if cfg.depgraph:
+            # chain-depth / convoy aggregates from this tick's
+            # blocker-pointer plane (iterated pointer doubling)
+            stats, dep_dmax, dep_conv = obs_depgraph.tick_planes(
+                stats, measuring)
         if cfg.trace_ticks > 0:
             live_delta, ovf_delta = 0, 0
             if "live_entry_cnt" in db:
@@ -959,6 +1037,10 @@ def make_tick(cfg: Config, plugin, pool_dev: dict, workload=None):
             stats = obs_trace.record_queue(stats, t)
             stats = obs_trace.record_ctrl(stats, t)
             stats = obs_trace.record_slo(cfg, stats, t)
+            if dep_base is not None:
+                stats = obs_trace.record_dep(
+                    stats, t, stats["arr_dep_cnt"] - dep_base,
+                    dep_dmax, dep_conv)
 
         # ts wraparound guard: only relative order matters, and every live
         # txn's ts lies within [ts_counter - horizon, ts_counter], so rebase
@@ -1199,6 +1281,11 @@ class Engine:
             # verdict and ring geometry — merged only when the plane is
             # on, like every other opt-in observatory
             out.update(obs_windows.summary_keys(self.cfg, state.stats))
+        if "arr_dep_cnt" in state.stats:
+            # dependency observatory (obs/depgraph.py): ring fill / wrap
+            # flag and the peak chain-depth / convoy-width gauges —
+            # merged only when the plane is on
+            out.update(obs_depgraph.summary_keys(state.stats))
         if wall_seconds is not None:
             out["tput"] = s["txn_cnt"] / wall_seconds
         if self.xmeter is not None:

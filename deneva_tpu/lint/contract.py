@@ -89,19 +89,21 @@ def check_output(kind: str, value, db_sig) -> str | None:
         return None
     if kind == "decision":
         leaves = jax.tree_util.tree_leaves(value)
-        if len(leaves) not in (3, 4):
+        if len(leaves) not in (3, 4, 5):
             return (f"decision: expected 3 (B, R) masks "
-                    f"(grant, wait, abort) plus an optional int32 "
-                    f"reason plane, got {len(leaves)} leaves")
+                    f"(grant, wait, abort) plus optional int32 "
+                    f"reason/blocker planes, got {len(leaves)} leaves")
         for nm, v in zip(("grant", "wait", "abort"), leaves):
             if tuple(v.shape) != (B, R) or jnp.dtype(v.dtype) != bool:
                 return (f"decision.{nm}: want (B, R)=({B}, {R}) bool, "
                         f"got {tuple(v.shape)} {jnp.dtype(v.dtype).name}")
-        if len(leaves) == 4:
-            v = leaves[3]
+        # optional planes (reason / blocker — None fields drop out of the
+        # flatten, so either may appear alone): both are (B, R) int32
+        for i, v in enumerate(leaves[3:]):
             if tuple(v.shape) != (B, R) or \
                     jnp.dtype(v.dtype) != jnp.int32:
-                return (f"decision.reason: want (B, R)=({B}, {R}) int32, "
+                return (f"decision extra plane {i}: want (B, R)="
+                        f"({B}, {R}) int32, "
                         f"got {tuple(v.shape)} {jnp.dtype(v.dtype).name}")
         return None
     if kind == "votes":

@@ -1,0 +1,535 @@
+"""Conflict dependency observatory: the device-resident wait-for graph
+(obs pillar 8 — "WHO is in the way", the question the reference answers
+by walking lock-owner lists in a debugger).
+
+Every prior pillar measures the fleet (counters, timelines, windows) or
+the victim (flight spans, abort taxonomy).  None of them name the OTHER
+txn: the lock holder a WAIT parked behind, the conflicting writer a
+TIMESTAMP/MVCC abort lost to, the validation victim an OCC rollback was
+charged against.  Opt-in through ``Config.depgraph`` (requires
+``abort_attribution``), every cc plugin emits a blocker identity
+alongside its grant/wait/abort decision (``AccessDecision.blocker``,
+slot+1 wire encoding, 0 = none) and the engine carries four device
+planes inside the stats dict:
+
+- **edge ring** ``arr_dep_ring`` (``Config.dep_samples`` rows x
+  EDGE_COLUMNS): one sampled ``(waiter, blocker, key, reason, tick,
+  node)`` row per WAIT decision (reason 0) and per abort EVENT (the
+  normalized cc/base.py reason code), appended with the repo's
+  keep-last ring + distinct-OOB-dead-lane scatter discipline (LINT.md)
+  at EXACTLY the sites that bump ``twopl_wait_cnt`` and the
+  ``abort_<reason>_cnt`` taxonomy — same masks, same warmup gate on the
+  counters — so the ring partitions exactly against both families;
+- **blocker-pointer plane** ``arr_dep_blocker`` (``(B,)``; -1 = not
+  waiting): this tick's wait-for graph as a functional graph (each
+  waiter names at most one blocker), refreshed from the access
+  decisions every tick;
+- **aggregate planes**: chain-depth histogram ``arr_dep_depth_hist``
+  (last bin saturates: cycles land there), per-partition edge counts
+  ``arr_dep_part`` (key % part_cnt; keyless whole-txn events count in
+  ``dep_nullkey_edge_cnt`` so the partition plane still sums exactly),
+  and the run-peak gauges ``arr_dep_peak`` ([max chain depth, max
+  convoy width] — max-merged across nodes, never summed);
+- **summable scalars** ``dep_*`` (0-d int32, auto-[summary] /
+  auto-psum / window-vocabulary like every other counter):
+  ``dep_wait_edge_cnt``, ``dep_abort_edge_cnt``,
+  ``dep_nullkey_edge_cnt``, ``dep_cross_edge_cnt`` (sharded: blocker
+  resident on another node), ``dep_depth_sum`` (per-tick sum of
+  waiting lanes' chain depths) and ``dep_convoy_width_sum`` (per-tick
+  max blocker in-degree).
+
+Chain depth is computed per tick by ITERATED POINTER DOUBLING over the
+blocker plane (``chain_depths``): ceil(log2(B)) gather rounds instead
+of a B-step walk, cycles saturate instead of hanging.  The convoy plane
+is the blocker in-degree histogram — a depth-1 convoy of width w is w
+txns parked behind one holder, the gate-serialization signature.
+
+Exactness contract (the PR 4 taxonomy / PR 6 conservation discipline),
+for every plugin and both engines while the ring has not wrapped::
+
+    dep_wait_edge_cnt            == twopl_wait_cnt
+    dep_abort_edge_cnt           == sum(abort_<reason>_cnt)
+    ring rows (reason == 0)      == dep_wait_edge_cnt        (measured)
+    ring rows (reason == r)      == abort_<r>_cnt            (per r)
+    sum(arr_dep_part) + dep_nullkey_edge_cnt
+                                 == dep_wait + dep_abort edges
+
+A wrapped ring REFUSES to reconcile (loudly, first finding) rather than
+degrade to approximate counts.
+
+In ``ShardedEngine`` blocker identities are GLOBAL txn ids
+(``node * B + slot``), the per-tick blocker planes all_gather into one
+cluster-wide functional graph (so a chain crossing nodes measures its
+true depth on every member's home node), and the summable planes psum
+into a cluster plane bit-equal to the numpy shard sum.
+
+Host-side exports:
+
+- :func:`snapshot`        numpy -> dicts (edges with node tags + the
+                          aggregate planes);
+- :func:`reconcile`       the exact identities above, as mismatch
+                          tuples (tests + the bench --depgraph gate);
+- :func:`cycles`          would-be-deadlock cycles over each tick's
+                          sampled functional graph (O(edges));
+- :func:`critical_paths`  commit critical-path decomposition: the
+                          longest blocking chain behind each sampled
+                          commit, joined against the obs/flight.py
+                          span ring;
+- :func:`flow_events`     Perfetto FLOW arrows blocker -> waiter that
+                          merge into the flight span track (string
+                          ``dep<n>`` flow ids — a namespace that can
+                          never collide with the recorder's integer
+                          abort-flow ids);
+- :func:`summary_keys` / :func:`record_extra`  [summary] bookkeeping
+                          and the run-record ``"depgraph"`` block
+                          (obs/report.py renders it as [depgraph]).
+
+When ``Config.depgraph`` is False (default) no arrays are carried and
+the [summary] line is byte-identical to a build without this module
+(config._optin registers the claim; tests/test_certify.py proves it).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from deneva_tpu.cc import base as cc_base
+from deneva_tpu.engine.state import NULL_KEY
+
+#: edge row schema.  ``waiter``/``blocker`` are txn slots (GLOBAL ids
+#: ``node * B + slot`` in the sharded engine; blocker -1 = the decision
+#: carried no identity — e.g. a window-mode fast path or a
+#: history-conflict abort with no live opponent); ``reason`` 0 = WAIT
+#: edge, else the normalized cc/base.py abort code; ``key`` the
+#: contended row (NULL_KEY for whole-txn events); ``tick`` the decision
+#: tick; ``node`` the WAITER's home node.
+EDGE_COLUMNS = ("waiter", "blocker", "key", "reason", "tick", "node")
+DCOL = {name: i for i, name in enumerate(EDGE_COLUMNS)}
+
+#: chain-depth histogram bins; the last bin saturates (depth >=
+#: DEPTH_BINS - 1, including cycle members, whose doubled depth clamps)
+DEPTH_BINS = 16
+
+#: run-peak gauge layout of ``arr_dep_peak``
+PEAK_COLUMNS = ("depth", "convoy")
+
+
+# ---------------------------------------------------------------------------
+# device side (jit-safe; every helper no-ops when the plane is absent)
+# ---------------------------------------------------------------------------
+
+def init_depgraph(cfg) -> dict:
+    """Stats-dict entries for the observatory; empty when off (the
+    disabled path carries nothing).  The ``dep_*`` 0-d scalars ride the
+    generic counter machinery (summary scrape, sharded psum, window
+    vocabulary); the ``arr_*`` planes are excluded from all three and
+    fetched whole by :func:`snapshot`."""
+    if not cfg.depgraph:
+        return {}
+    B, S = cfg.batch_size, cfg.dep_samples
+    out = {
+        "arr_dep_ring": jnp.zeros((S, len(EDGE_COLUMNS)), jnp.int32),
+        "arr_dep_blocker": jnp.full((B,), -1, jnp.int32),
+        "arr_dep_depth_hist": jnp.zeros((DEPTH_BINS,), jnp.int32),
+        "arr_dep_part": jnp.zeros((cfg.part_cnt,), jnp.int32),
+        "arr_dep_peak": jnp.zeros((len(PEAK_COLUMNS),), jnp.int32),
+        # cumulative ring appends: the cursor (pos = cnt + rank mod S)
+        # and the host's wrap detector; arr_-prefixed on purpose — the
+        # per-node value must NOT be psum-merged (wrap detection is
+        # per-ring), snapshot/summary_keys read it raw
+        "arr_dep_cnt": jnp.zeros((), jnp.int32),
+    }
+    for k in ("dep_wait_edge_cnt", "dep_abort_edge_cnt",
+              "dep_nullkey_edge_cnt", "dep_cross_edge_cnt",
+              "dep_depth_sum", "dep_convoy_width_sum"):
+        out[k] = jnp.zeros((), jnp.int32)
+    return out
+
+
+def note_waits(stats: dict, wait_b, blocker_b) -> dict:
+    """Refresh the blocker-pointer plane from this tick's access
+    decisions: waiting lanes point at their blocker's slot (-1 = waiting
+    with no identified blocker), every other lane clears to -1.  Called
+    once per tick at the SAME site that bumps ``twopl_wait_cnt``."""
+    if "arr_dep_blocker" not in stats:
+        return stats
+    return {**stats,
+            "arr_dep_blocker": jnp.where(wait_b, blocker_b, -1)
+            .astype(jnp.int32)}
+
+
+def record_edges(stats: dict, counter: str, mask_b, blocker_b, key_b,
+                 reason_b, t, measuring, node=0, cross_b=None) -> dict:
+    """Scatter one edge row per masked lane into the keep-last ring and
+    bump ``counter`` (``dep_wait_edge_cnt`` / ``dep_abort_edge_cnt``)
+    by the MEASURED edge count — the same warmup gate as the counter
+    family the identity targets.  The ring itself records warmup edges
+    too (the host filters by tick, obs/flight.py discipline), so the
+    trace shows warmup dynamics.  ``blocker_b`` is the resolved slot
+    (-1 = none), NOT the wire slot+1 encoding; ``cross_b`` marks edges
+    whose blocker lives on another node (sharded engine)."""
+    if "arr_dep_ring" not in stats:
+        return stats
+    ring = stats["arr_dep_ring"]
+    cap = ring.shape[0]
+    B = mask_b.shape[0]
+    m32 = mask_b.astype(jnp.int32)
+    rank = jnp.cumsum(m32) - m32
+    n = jnp.sum(m32)
+    live = mask_b & (rank >= n - cap)
+    pos = jnp.where(live, (stats["arr_dep_cnt"] + rank) % cap,
+                    cap + jnp.arange(B, dtype=jnp.int32))
+    row = jnp.stack([
+        jnp.arange(B, dtype=jnp.int32)
+        + jnp.asarray(node, jnp.int32) * B,               # global waiter
+        blocker_b.astype(jnp.int32),
+        key_b.astype(jnp.int32),
+        jnp.broadcast_to(jnp.asarray(reason_b, jnp.int32), (B,)),
+        jnp.broadcast_to(jnp.asarray(t, jnp.int32), (B,)),
+        jnp.broadcast_to(jnp.asarray(node, jnp.int32), (B,)),
+    ], axis=1)
+    meas = mask_b & measuring
+    nm = jnp.sum(meas.astype(jnp.int32))
+    haskey = key_b != NULL_KEY
+    part = stats["arr_dep_part"]
+    P = part.shape[0]
+    ppos = jnp.where(meas & haskey, key_b % P, P)
+    out = {**stats,
+           "arr_dep_ring": ring.at[pos].set(row, mode="drop",
+                                            unique_indices=True),
+           "arr_dep_cnt": stats["arr_dep_cnt"] + n,
+           "arr_dep_part": part.at[ppos].add(1, mode="drop"),
+           counter: stats[counter] + nm,
+           "dep_nullkey_edge_cnt": stats["dep_nullkey_edge_cnt"]
+           + jnp.sum((meas & ~haskey).astype(jnp.int32))}
+    # lint: disable-next=TRACED-BRANCH is-None STRUCTURE check: cross_b is None iff the caller is the single-shard engine (static per call site), never a traced-value branch
+    if cross_b is not None:
+        out["dep_cross_edge_cnt"] = stats["dep_cross_edge_cnt"] \
+            + jnp.sum((meas & cross_b).astype(jnp.int32))
+    return out
+
+
+def chain_depths(ptr):
+    """Chain depth of every lane of a ``(M,)`` blocker-pointer plane
+    (-1 = no blocker) by iterated pointer doubling: ceil(log2(M))
+    rounds of ``depth[i] += depth[ptr[i]]; ptr[i] = ptr[ptr[i]]``.
+    Self-loops are masked; members of longer cycles never reach -1 and
+    their depth saturates toward 2^rounds >= M (callers clamp)."""
+    M = ptr.shape[0]
+    idx = jnp.arange(M, dtype=jnp.int32)
+    ptr = jnp.where(ptr == idx, -1, ptr)
+    depth = (ptr >= 0).astype(jnp.int32)
+    for _ in range(max((M - 1).bit_length(), 1)):
+        j = jnp.clip(ptr, 0)
+        nd = depth + jnp.where(ptr >= 0, depth[j], 0)
+        ptr = jnp.where(ptr >= 0, ptr[j], ptr)
+        depth = nd
+    return depth
+
+
+def tick_planes(stats: dict, measuring, ptr=None, lo=None):
+    """End-of-tick aggregates from the blocker-pointer plane: chain
+    depths (pointer doubling), the depth histogram, the convoy
+    (blocker in-degree) width, and the run peaks.  Returns
+    ``(stats, depth_max, convoy_width)`` — the per-tick gauges feed the
+    trace companion ring (obs/trace.py record_dep).
+
+    Single shard: reads ``arr_dep_blocker`` directly.  Sharded: pass
+    the all_gathered GLOBAL plane as ``ptr`` and this node's first
+    global slot as ``lo`` — depths/in-degrees compute over the whole
+    cluster graph, then each node banks only its OWN ``B`` lanes, so
+    the psum of the summable planes counts every lane exactly once
+    while cross-node chains still measure their true depth."""
+    if "arr_dep_blocker" not in stats:
+        return stats, jnp.int32(0), jnp.int32(0)
+    local = stats["arr_dep_blocker"]
+    B = local.shape[0]
+    full = local if ptr is None else ptr
+    M = full.shape[0]
+    idx = jnp.arange(M, dtype=jnp.int32)
+    full = jnp.where(full == idx, -1, full)
+    waiting = full >= 0
+    depth = jnp.minimum(chain_depths(full), M)   # cycles read as M
+    heads = jnp.zeros(M + 1, jnp.int32).at[
+        jnp.where(waiting, full, M)].add(1)
+    if ptr is None:
+        d_l, w_l, h_l = depth, waiting, heads[:M]
+    else:
+        start = (jnp.asarray(lo, jnp.int32),)
+        d_l = jax.lax.dynamic_slice(depth, start, (B,))
+        w_l = jax.lax.dynamic_slice(waiting, start, (B,))
+        h_l = jax.lax.dynamic_slice(heads, start, (B,))
+    d_l = jnp.where(w_l, d_l, 0)
+    dmax = jnp.max(d_l)
+    width = jnp.max(h_l)
+    g = measuring.astype(jnp.int32)
+    bins = stats["arr_dep_depth_hist"].shape[0]
+    hpos = jnp.where(w_l & measuring, jnp.minimum(d_l, bins - 1), bins)
+    out = {**stats,
+           "arr_dep_depth_hist":
+           stats["arr_dep_depth_hist"].at[hpos].add(1, mode="drop"),
+           "arr_dep_peak": jnp.maximum(stats["arr_dep_peak"],
+                                       jnp.stack([dmax, width]) * g),
+           "dep_depth_sum": stats["dep_depth_sum"] + g * jnp.sum(d_l),
+           "dep_convoy_width_sum":
+           stats["dep_convoy_width_sum"] + g * width}
+    return out, dmax, width
+
+
+# ---------------------------------------------------------------------------
+# host side
+# ---------------------------------------------------------------------------
+
+def _ring_rows(ring: np.ndarray, cnt: int) -> np.ndarray:
+    """Valid rows of a keep-last ring in chronological order."""
+    cap = ring.shape[0]
+    if cnt <= cap:
+        return ring[:cnt]
+    return np.roll(ring, -(cnt % cap), axis=0)
+
+
+def _edge_dict(r, reasons) -> dict:
+    d = {c: int(r[i]) for i, c in enumerate(EDGE_COLUMNS)}
+    d["why"] = ("wait" if d["reason"] == 0
+                else reasons[min(max(d["reason"], 0), len(reasons) - 1)])
+    return d
+
+
+def snapshot(state_or_stats) -> dict:
+    """Fetch the observatory planes as plain dicts (JSON-ready; lands
+    in profiler run records under the top-level ``"depgraph"`` key).
+    Sharded states arrive node-stacked; per-node rings merge on the
+    shared tick clock, summable planes sum, peak gauges max."""
+    stats = getattr(state_or_stats, "stats", state_or_stats)
+    assert "arr_dep_ring" in stats, "run with Config.depgraph"
+    ring = np.asarray(stats["arr_dep_ring"])
+    hist = np.asarray(stats["arr_dep_depth_hist"])
+    part = np.asarray(stats["arr_dep_part"])
+    peak = np.asarray(stats["arr_dep_peak"])
+    blk = np.asarray(stats["arr_dep_blocker"])
+    cnt = np.asarray(stats["arr_dep_cnt"])
+    if ring.ndim == 2:                       # single shard -> 1-node stack
+        ring, hist, part, peak, blk = (a[None] for a in
+                                       (ring, hist, part, peak, blk))
+        cnt = cnt.reshape(1)
+    N, S, _ = ring.shape
+    B = blk.shape[1]
+    reasons = ("wait",) + tuple(cc_base.ABORT_REASONS)
+    edges = []
+    for node in range(N):
+        for r in _ring_rows(ring[node], int(cnt[node])):
+            d = _edge_dict(r, reasons)
+            if N > 1 and d["blocker"] >= 0:
+                d["blocker_node"] = d["blocker"] // B
+                d["blocker_slot"] = d["blocker"] % B
+            edges.append(d)
+    edges.sort(key=lambda d: (d["tick"], d["node"], d["waiter"]))
+    out = {"columns": list(EDGE_COLUMNS),
+           "nodes": N, "samples": S, "batch": B,
+           "edge_cnt": int(cnt.sum()),
+           "wrapped": bool((cnt > S).any()),
+           "edges": edges,
+           "depth_hist": hist.sum(axis=0).tolist(),
+           "part_edges": part.sum(axis=0).tolist(),
+           "peak_depth": int(peak[:, 0].max()),
+           "peak_convoy": int(peak[:, 1].max())}
+    for k in ("dep_wait_edge_cnt", "dep_abort_edge_cnt",
+              "dep_nullkey_edge_cnt", "dep_cross_edge_cnt",
+              "dep_depth_sum", "dep_convoy_width_sum"):
+        out[k] = int(np.asarray(stats[k]).sum())
+    return out
+
+
+def reconcile(snap: dict, summary: dict, warmup_ticks: int = 0) -> list:
+    """The full-sampling exactness checks, as ``(what, got, want)``
+    mismatch tuples (empty = exact).  A wrapped ring is REFUSED — it is
+    reported as the sole finding and nothing else is checked, because a
+    keep-last window cannot prove any of the count identities."""
+    if snap["wrapped"]:
+        return [("dep_ring_wrapped", snap["edge_cnt"], snap["samples"])]
+    bad = []
+    if "twopl_wait_cnt" in summary:
+        want = int(summary["twopl_wait_cnt"])
+        if snap["dep_wait_edge_cnt"] != want:
+            bad.append(("wait_edges_vs_twopl_wait",
+                        snap["dep_wait_edge_cnt"], want))
+    meas = [e for e in snap["edges"] if e["tick"] >= warmup_ticks]
+    got = sum(1 for e in meas if e["reason"] == 0)
+    if got != snap["dep_wait_edge_cnt"]:
+        bad.append(("ring_wait_rows", got, snap["dep_wait_edge_cnt"]))
+    hist: dict = {}
+    for e in meas:
+        if e["reason"] != 0:
+            hist[e["why"]] = hist.get(e["why"], 0) + 1
+    for name in cc_base.ABORT_REASONS:
+        want = int(summary.get(f"abort_{name}_cnt", 0))
+        if hist.get(name, 0) != want:
+            bad.append((f"ring_abort_{name}", hist.get(name, 0), want))
+    taxo = sum(int(summary.get(f"abort_{name}_cnt", 0))
+               for name in cc_base.ABORT_REASONS)
+    if f"abort_{cc_base.ABORT_REASONS[0]}_cnt" in summary \
+            and snap["dep_abort_edge_cnt"] != taxo:
+        bad.append(("abort_edges_vs_taxonomy",
+                    snap["dep_abort_edge_cnt"], taxo))
+    got = sum(snap["part_edges"]) + snap["dep_nullkey_edge_cnt"]
+    want = snap["dep_wait_edge_cnt"] + snap["dep_abort_edge_cnt"]
+    if got != want:
+        bad.append(("partition_plane_total", got, want))
+    return bad
+
+
+def _blocker_vertex(snap: dict, e: dict) -> tuple:
+    if snap["nodes"] > 1:
+        return (e["blocker"] // snap["batch"],
+                e["blocker"] % snap["batch"])
+    return (e["node"], e["blocker"])
+
+
+def cycles(snap: dict, warmup_ticks: int = 0) -> list:
+    """Would-be-deadlock cycles over each tick's sampled wait-for
+    graph.  Per tick the graph is FUNCTIONAL (each waiter names at most
+    one blocker), so one pointer walk with visit coloring finds every
+    cycle in O(edges); cross-node cycles come out for free from the
+    global blocker ids.  Returns ``[{"tick", "cycle": [[node, slot],
+    ...]}, ...]`` — under NO_WAIT-style policies these are the
+    deadlocks the eager abort PREVENTED, measured instead of assumed."""
+    by_tick: dict = {}
+    for e in snap["edges"]:
+        if e["tick"] < warmup_ticks or e["blocker"] < 0:
+            continue
+        by_tick.setdefault(e["tick"], {})[(e["node"], e["waiter"]
+                                           % snap["batch"]
+                                           if snap["nodes"] > 1
+                                           else e["waiter"])] = e
+    out = []
+    for t, emap in sorted(by_tick.items()):
+        done: set = set()
+        for v0 in emap:
+            if v0 in done:
+                continue
+            path: list = []
+            seen: dict = {}
+            u = v0
+            while u in emap and u not in done:
+                if u in seen:
+                    out.append({"tick": t,
+                                "cycle": [list(x) for x in
+                                          path[seen[u]:]]})
+                    break
+                seen[u] = len(path)
+                path.append(u)
+                u = _blocker_vertex(snap, emap[u])
+            done.update(path)
+    return out
+
+
+def critical_paths(snap: dict, flight_snap: dict, topk: int = 10,
+                   warmup_ticks: int = 0) -> list:
+    """Commit critical-path decomposition: for each committed span the
+    flight recorder sampled, the LONGEST blocking chain behind it —
+    walk the sampled wait edges of its lifetime, tick by tick,
+    following blocker pointers within the tick.  Rows sort by the
+    span's blocked ticks (the lat_cc_block_time contribution), so the
+    head of the list is the commit whose latency the graph explains
+    most."""
+    emap: dict = {}
+    for e in snap["edges"]:
+        if e["tick"] < warmup_ticks or e["reason"] != 0:
+            continue
+        w = (e["waiter"] % snap["batch"] if snap["nodes"] > 1
+             else e["waiter"])
+        emap[(e["node"], w, e["tick"])] = e
+
+    def chain(node, slot, tick):
+        path, seen = [], set()
+        cur = (node, slot)
+        while (*cur, tick) in emap and cur not in seen:
+            seen.add(cur)
+            e = emap[(*cur, tick)]
+            path.append(e)
+            if e["blocker"] < 0:
+                break
+            cur = _blocker_vertex(snap, e)
+        return path
+
+    rows = []
+    for d in flight_snap.get("spans", ()):
+        if d.get("kind", 0) != 0:
+            continue
+        best: list = []
+        for t in range(d["admit"], d["end"] + 1):
+            p = chain(d["node"], d["slot"], t)
+            if len(p) > len(best):
+                best = p
+        if not best:
+            continue
+        rows.append({
+            "node": d["node"], "slot": d["slot"],
+            "admit": d["admit"], "end": d["end"],
+            "latency": d["end"] - d["admit"],
+            "block_ticks": d.get("block", 0),
+            "max_depth": len(best),
+            "at_tick": best[0]["tick"],
+            "path": [{k: e[k] for k in
+                      ("waiter", "blocker", "key", "node")}
+                     for e in best]})
+    rows.sort(key=lambda r: (-r["block_ticks"], -r["max_depth"]))
+    return rows[:topk]
+
+
+def flow_events(snap: dict, tick_us: float = 1.0,
+                limit: int = 4096) -> list:
+    """Perfetto FLOW arrows blocker -> waiter, merging into the flight
+    span track (same pid=node / tid=slot addressing as
+    obs/flight.py span_events).  Flow ids are STRINGS (``"dep<n>"``) —
+    a namespace disjoint by type from the recorder's integer abort-flow
+    ids, so the merged document never aliases arrows
+    (tests/test_depgraph.py regression).  Wait edges draw as "blocks",
+    abort edges as "kills:<reason>"."""
+    events = []
+    n = 0
+    for e in snap["edges"]:
+        if e["blocker"] < 0:
+            continue
+        if n >= limit:
+            break
+        bnode, bslot = _blocker_vertex(snap, e)
+        wslot = (e["waiter"] % snap["batch"] if snap["nodes"] > 1
+                 else e["waiter"])
+        name = "blocks" if e["reason"] == 0 else f"kills:{e['why']}"
+        fid = f"dep{n}"
+        n += 1
+        events.append({"name": name, "cat": "dep-flow", "ph": "s",
+                       "id": fid, "ts": e["tick"] * tick_us,
+                       "pid": bnode, "tid": bslot})
+        events.append({"name": name, "cat": "dep-flow", "ph": "f",
+                       "bp": "e", "id": fid,
+                       "ts": (e["tick"] + 0.5) * tick_us,
+                       "pid": e["node"], "tid": wslot})
+    return events
+
+
+def summary_keys(stats: dict) -> dict:
+    """[summary] bookkeeping merged by Engine.summary when the plane is
+    on: ring fill / wrap flag (max across nodes — wrap is per-ring) and
+    the cluster peak gauges (max-merged, never summed).  All integers,
+    stats.py dep_* passthrough (never time-scaled)."""
+    cnt = np.asarray(stats["arr_dep_cnt"]).reshape(-1)
+    S = int(np.asarray(stats["arr_dep_ring"]).shape[-2])
+    peak = np.asarray(stats["arr_dep_peak"]).reshape(-1,
+                                                     len(PEAK_COLUMNS))
+    return {"dep_ring_cnt": int(cnt.max()),
+            "dep_ring_wrapped": int(bool((cnt > S).any())),
+            "dep_peak_depth": int(peak[:, 0].max()),
+            "dep_peak_convoy": int(peak[:, 1].max())}
+
+
+def record_extra(cfg, stats: dict) -> dict:
+    """Run-record block (obs/profiler.py): the full snapshot under the
+    top-level ``"depgraph"`` key; empty when the plane is off."""
+    if "arr_dep_ring" not in stats:
+        return {}
+    return {"depgraph": snapshot(stats)}

@@ -11,7 +11,12 @@ ONE Chrome trace-event JSON loadable at ui.perfetto.dev:
 - the per-txn SPAN track from each record's ``flight`` snapshot
   (obs/flight.py span_events: nested lifecycle/attempt slices with
   abort-reason flow arrows) — counters above, the sampled lifecycles
-  that explain them below, on one shared tick clock.
+  that explain them below, on one shared tick clock;
+- blocker->waiter flow arrows from each record's ``depgraph`` snapshot
+  (obs/depgraph.py flow_events), merged into the same span track.  Flow
+  ids are re-keyed per record (prefixed into ``"<pid_base>:<fid>"``
+  strings) because Perfetto unites flow phases by id alone — see
+  ``_rekey_flows``.
 
 Records merge side by side as separate Perfetto process groups (one pid
 block per record, per node), so a 7-algorithm bench sweep reads as seven
@@ -46,6 +51,28 @@ def _series(timeline: dict, name: str, node: int, n_nodes: int):
     if col and isinstance(col[0], list):      # (N, T) per-shard series
         return col[node] if node < len(col) else None
     return col if node == 0 else None
+
+
+def _rekey_flows(evs, pid_base: int) -> list:
+    """Shift span/flow events into a record's pid block AND re-key their
+    flow ids into a per-record namespace.  Perfetto unites flow phases
+    ("s"/"t"/"f") by id alone, not (pid, id) — two merged records each
+    emitting flight flow 1 would otherwise draw one arrow spanning
+    unrelated process groups.  Every id becomes the STRING
+    ``"<pid_base>:<fid>"``: the prefix separates records, and within one
+    record the flight recorder's integer abort-flow ids ("0:51") can
+    never render equal to a depgraph blocker id ("0:dep51") — additive
+    integer striding would alias records (``(i + f) * stride`` collides
+    across (record, fid) pairs; tests/test_depgraph.py regression)."""
+    out = []
+    for ev in evs:
+        ev = dict(ev)
+        ev["pid"] = pid_base + ev["pid"]
+        fid = ev.get("id")
+        if fid is not None:
+            ev["id"] = f"{pid_base}:{fid}"
+        out.append(ev)
+    return out
 
 
 def record_events(rec: dict, pid_base: int = 0, tick_us: float = 1.0,
@@ -99,7 +126,11 @@ def record_events(rec: dict, pid_base: int = 0, tick_us: float = 1.0,
                              ("admission queue", ("queue_depth",)),
                              ("mesh traffic", mesh_names),
                              ("controller decisions", ctrl_names),
-                             ("slo burn rate", slo_names)):
+                             ("slo burn rate", slo_names),
+                             # conflict dependency observatory planes
+                             # (obs/trace.py DEP_COLUMNS)
+                             ("chain depth", ("dep_edges", "dep_depth",
+                                              "dep_convoy"))):
             series = {c: _series(timeline, c, node, n_nodes)
                       for c in cols}
             series = {c: s for c, s in series.items() if s is not None}
@@ -113,10 +144,16 @@ def record_events(rec: dict, pid_base: int = 0, tick_us: float = 1.0,
                                         for c in series}})
     if flight:
         from deneva_tpu.obs import flight as obs_flight
-        for ev in obs_flight.span_events(flight, tick_us=tick_us):
-            ev = dict(ev)
-            ev["pid"] = pid_base + ev["pid"]
-            events.append(ev)
+        events.extend(_rekey_flows(
+            obs_flight.span_events(flight, tick_us=tick_us), pid_base))
+    dep = rec.get("depgraph")
+    if dep and dep.get("edges"):
+        # blocker->waiter flow arrows of the conflict dependency
+        # observatory (obs/depgraph.py flow_events), same per-record
+        # pid/flow-id namespacing as the flight span track above
+        from deneva_tpu.obs import depgraph as obs_depgraph
+        events.extend(_rekey_flows(
+            obs_depgraph.flow_events(dep, tick_us=tick_us), pid_base))
     win = rec.get("windows")
     if win and not win.get("wrapped"):
         # window-delta counter track (obs/trace.py's conditional 11th

@@ -42,6 +42,13 @@ INVERTED — the latency tail GROWING past (1 + tol) x median under the
 same flash-crowd schedule is the regression the SLO plane exists to
 catch.
 
+Dependency-observatory records (bench.py ``--depgraph``) carry one
+``depgraph_chain`` cell per algorithm with its peak wait-chain depth;
+gated INVERTED like the SLO tails — the deepest blocking chain GROWING
+past (1 + tol) x median on the same contended cell means commits now
+serialize behind longer dependency chains than they used to.  Self-arms
+on the first recorded sweep, like every other cell family.
+
 Every point records the ``platform`` it was measured on (bench.py tags
 ``jax.default_backend()``), and the gate compares same-platform
 trajectories ONLY: a CPU smoke point never gates against TPU history or
@@ -189,6 +196,18 @@ def _entry(source: str, order: tuple, doc: dict) -> Optional[dict]:
         except (TypeError, ValueError):
             continue
     out["slo_p99"] = slo
+    # dependency-observatory records (bench.py --depgraph) carry one
+    # peak wait-chain depth per algorithm; gated INVERTED like the SLO
+    # tails (depth growing = commits serialize behind longer chains),
+    # self-arming on the first recorded sweep
+    chains = {}
+    for cell_key, cell in (doc.get("depgraph_chain") or {}).items():
+        try:
+            chains[cell_key] = float(cell.get("max_chain_depth")
+                                     if isinstance(cell, dict) else cell)
+        except (TypeError, ValueError):
+            continue
+    out["depgraph_chain"] = chains
     return out
 
 
@@ -390,6 +409,16 @@ def gate(entries: list[dict], current: Optional[dict] = None,
         check_ceiling(f"slo_p99[{cell_key}]", cur,
                       [e["slo_p99"][cell_key] for e in prior
                        if cell_key in e.get("slo_p99", {})],
+                      cpt_tolerance)
+    # wait-chain-depth trajectory (--depgraph records): INVERTED — the
+    # per-alg peak chain depth GROWING past the ceiling means the same
+    # contended cell now serializes commits behind longer dependency
+    # chains than it used to; self-arms once the first sweep lands
+    for cell_key, cur in sorted(current.get("depgraph_chain",
+                                            {}).items()):
+        check_ceiling(f"depgraph_max_chain_depth[{cell_key}]", cur,
+                      [e["depgraph_chain"][cell_key] for e in prior
+                       if cell_key in e.get("depgraph_chain", {})],
                       cpt_tolerance)
     result = {"current": current, "checks": checks, "failures": failures,
               "skipped": skipped}

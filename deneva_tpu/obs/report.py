@@ -43,6 +43,12 @@ the full mask (the README watchdog table mirrors it)::
                     clears before run end and does not fire), or the
                     exact histogram-total == committed-txn
                     reconciliation identity failed
+    CONVOY   (256)  Config.depgraph runs: the sustained mean convoy
+                    width (dep_convoy_width_sum / measured_ticks,
+                    obs/depgraph.py — the per-tick max blocker
+                    in-degree) stayed at or above CONVOY_WIDTH_MIN —
+                    the run spent its measured window serialized behind
+                    single hot blockers, not merely contended
 
 CLI: ``python -m deneva_tpu.obs.report <run_record.json> [--json]``
 exits with the watchdog bitmask, so a CI stage can gate on it
@@ -65,12 +71,16 @@ OVERLOAD = 16
 IMBALANCE = 32
 RECOVERY = 64
 SLO = 128
+CONVOY = 256
 
 #: a zero-commit run of at least this many ticks, with abort/admission
 #: churn inside it, is flagged as live-lock
 LIVELOCK_WINDOW = 16
 #: compaction spills above this fraction of (commits + aborts) are a storm
 SPILL_FRAC = 0.05
+#: a run-mean convoy width (txns queued behind one blocker per measured
+#: tick) at or above this is a convoy, not ordinary contention
+CONVOY_WIDTH_MIN = 4
 
 #: the waterfall's phase rows: [summary] latency-decomposition integrals
 #: (engine/scheduler.py track_state_latencies; all in txn-slot-ticks) and
@@ -128,6 +138,23 @@ def reconcile(summary: dict, timeline: dict | None = None) -> list:
         if got != want:
             bad.append(f"taxonomy: sum(abort_*_cnt)={got} != "
                        f"total+vabort+user={want}")
+    # dependency-observatory edge counters (Config.depgraph,
+    # obs/depgraph.py): every CC wait decision records exactly one wait
+    # edge, every taxonomy abort exactly one abort edge — the counters
+    # are warmup-gated at the same site as their counterparts, so both
+    # identities are exact, not sampled
+    if "dep_wait_edge_cnt" in summary and "twopl_wait_cnt" in summary:
+        got = int(summary["dep_wait_edge_cnt"])
+        want = int(summary["twopl_wait_cnt"])
+        if got != want:
+            bad.append(f"depgraph: dep_wait_edge_cnt={got} != "
+                       f"twopl_wait_cnt={want}")
+    if "dep_abort_edge_cnt" in summary and rc:
+        got = int(summary["dep_abort_edge_cnt"])
+        want = sum(rc.values())
+        if got != want:
+            bad.append(f"depgraph: dep_abort_edge_cnt={got} != "
+                       f"sum(abort_*_cnt)={want}")
     if timeline is not None:
         def colsum(col):
             return int(np.asarray(timeline[col]).sum())
@@ -239,12 +266,52 @@ def _slo_section(summary: dict) -> dict | None:
     return out
 
 
+def _dep_section(summary: dict, depgraph: dict | None,
+                 flight: dict | None = None, topk: int = 8) -> dict | None:
+    """The ``[depgraph]`` section: what the conflict dependency
+    observatory (Config.depgraph, obs/depgraph.py) measured — exact
+    edge-counter totals from the summary, plus (when a ``snapshot()``
+    dict rides along) the sampled-graph views: wait-chain depth
+    histogram, cycles detected over the sampled edges, and the commit
+    critical paths joined against the flight recorder's sampled spans.
+    ``None`` (section omitted) when the plane was off."""
+    if "dep_wait_edge_cnt" not in summary:
+        return None
+    ticks = max(int(summary.get("measured_ticks", 0)), 1)
+    out = {
+        "wait_edges": int(summary["dep_wait_edge_cnt"]),
+        "abort_edges": int(summary.get("dep_abort_edge_cnt", 0)),
+        "cross_edges": int(summary.get("dep_cross_edge_cnt", 0)),
+        "nullkey_edges": int(summary.get("dep_nullkey_edge_cnt", 0)),
+        "peak_depth": int(summary.get("dep_peak_depth", 0)),
+        "peak_convoy": int(summary.get("dep_peak_convoy", 0)),
+        "mean_depth_sum": float(summary.get("dep_depth_sum", 0)) / ticks,
+        "mean_convoy": float(summary.get("dep_convoy_width_sum", 0))
+        / ticks,
+        "ring_cnt": int(summary.get("dep_ring_cnt", 0)),
+        "ring_wrapped": bool(summary.get("dep_ring_wrapped", 0)),
+    }
+    if depgraph is not None:
+        from deneva_tpu.obs import depgraph as obs_depgraph
+        out["depth_hist"] = [int(v) for v in depgraph["depth_hist"]]
+        out["part_edges"] = [int(v) for v in depgraph["part_edges"]]
+        cyc = obs_depgraph.cycles(depgraph)
+        out["cycles"] = len(cyc)
+        if cyc:
+            out["cycle_samples"] = cyc[:topk]
+        if flight is not None:
+            out["critical_paths"] = obs_depgraph.critical_paths(
+                depgraph, flight, topk=topk)
+    return out
+
+
 def build_report(summary: dict, timeline: dict | None = None,
                  stats: dict | None = None, topk: int = 8,
                  xmeter: dict | None = None,
                  flight: dict | None = None,
                  mesh: dict | None = None,
-                 diagnosis: dict | None = None) -> dict:
+                 diagnosis: dict | None = None,
+                 depgraph: dict | None = None) -> dict:
     """The machine-readable waterfall: phases (slot-ticks + share),
     throughput, the abort taxonomy, hot keys / per-partition conflicts /
     wait-depth histogram (when the run kept a heatmap), reconciliation
@@ -306,6 +373,9 @@ def build_report(summary: dict, timeline: dict | None = None,
         # (run diff, window-vs-window diff, or a regress-gate triage) —
         # ranked causes with their config levers ride the report
         rep["diagnosis"] = diagnosis
+    dep = _dep_section(summary, depgraph, flight=flight, topk=topk)
+    if dep is not None:
+        rep["depgraph"] = dep
     ctrl = _ctrl_section(summary)
     if ctrl is not None:
         rep["ctrl"] = ctrl
@@ -456,6 +526,24 @@ def watchdog(summary: dict, timeline: dict | None = None,
             f[0] == "RECONCILE" and f[1].startswith("histogram:")
             for f in findings):
         code |= SLO
+
+    # convoy serialization (Config.depgraph runs, obs/depgraph.py): the
+    # RUN-MEAN convoy width — txns parked behind a single blocker, per
+    # measured tick — held at CONVOY_WIDTH_MIN or above.  A transient
+    # pile-up averages out; a gate/hot-row convoy that serialized the
+    # whole measured window does not.
+    if "dep_convoy_width_sum" in summary:
+        ticks = max(int(summary.get("measured_ticks", 0)), 1)
+        mean_w = int(summary["dep_convoy_width_sum"]) / ticks
+        if mean_w >= CONVOY_WIDTH_MIN:
+            findings.append(
+                ("CONVOY", f"sustained convoy: mean width "
+                           f"{mean_w:.1f} >= {CONVOY_WIDTH_MIN} txns "
+                           f"behind one blocker (peak "
+                           f"{int(summary.get('dep_peak_convoy', 0))}, "
+                           f"peak chain depth "
+                           f"{int(summary.get('dep_peak_depth', 0))})"))
+            code |= CONVOY
     return findings, code
 
 
@@ -564,6 +652,36 @@ def render_text(rep: dict) -> str:
             lines.append("  exchange occupancy avg " + " ".join(
                 str(v) for v in pn["occ_avg"])
                 + f", peak {max(pn.get('occ_peak', [0]))}{cap}")
+    if rep.get("depgraph") is not None:
+        d = rep["depgraph"]
+        wrapped = " RING-WRAPPED" if d["ring_wrapped"] else ""
+        lines.append(
+            f"[depgraph] wait-for graph: {d['wait_edges']} wait / "
+            f"{d['abort_edges']} abort edges "
+            f"({d['cross_edges']} cross-node, "
+            f"{d['nullkey_edges']} keyless); chain depth "
+            f"mean {d['mean_depth_sum']:.1f} peak {d['peak_depth']}; "
+            f"convoy width mean {d['mean_convoy']:.1f} "
+            f"peak {d['peak_convoy']}; "
+            f"{d['ring_cnt']} edges sampled{wrapped}")
+        if d.get("depth_hist"):
+            dh = d["depth_hist"]
+            lines.append("  depth hist (waiters at chain depth d; last "
+                         f"bin = >={len(dh) - 1}): "
+                         + " ".join(str(v) for v in dh))
+        if d.get("cycles"):
+            lines.append(f"  CYCLES: {d['cycles']} deadlock cycle(s) in "
+                         "the sampled graph")
+            for c in d.get("cycle_samples", []):
+                path = " -> ".join(f"{n}:{s}" for n, s in c["cycle"])
+                lines.append(f"    tick {c['tick']}: {path}")
+        for cp in d.get("critical_paths", []):
+            path = " -> ".join(f"{e['node']}:{e['waiter']}"
+                               for e in cp["path"])
+            lines.append(
+                f"  critical-path slot {cp['node']}:{cp['slot']} "
+                f"latency {cp['latency']} (blocked {cp['block_ticks']}) "
+                f"depth {cp['max_depth']}@t{cp['at_tick']}: {path}")
     if rep.get("ctrl") is not None:
         c = rep["ctrl"]
         lines.append(
@@ -612,7 +730,8 @@ def report_from_record(rec: dict) -> dict:
                         xmeter=rec.get("xmeter"),
                         flight=rec.get("flight"),
                         mesh=rec.get("mesh"),
-                        diagnosis=rec.get("diagnosis"))
+                        diagnosis=rec.get("diagnosis"),
+                        depgraph=rec.get("depgraph"))
 
 
 def main(argv=None) -> int:

@@ -75,6 +75,14 @@ CTRL_COLUMNS = ("esc_active", "width_idx", "occ_ewma", "heat_max",
 #: derive from these two columns.
 PIPE_COLUMNS = ("pipe_legs", "pipe_overlap")
 
+#: dependency-observatory companion ring schema (Config.depgraph,
+#: obs/depgraph.py): per tick, the wait/abort EDGES appended to the
+#: sampling ring (a flow column — the tick's delta of arr_dep_cnt), the
+#: max wait-chain depth (pointer doubling) and the convoy width (max
+#: blocker in-degree).  The depth/convoy columns are gauges under the
+#: wrap-accumulate caveat of :func:`record_ctrl`.
+DEP_COLUMNS = ("dep_edges", "dep_depth", "dep_convoy")
+
 
 def init_trace(cfg, lat_samples: int) -> dict:
     """Stats-dict entries for the timeline; empty when tracing is off
@@ -110,6 +118,11 @@ def init_trace(cfg, lat_samples: int) -> dict:
         # discipline: non-adaptive traces carry nothing extra
         out["arr_ctrl_trace"] = jnp.zeros(
             (cfg.trace_ticks, len(CTRL_COLUMNS)), jnp.int32)
+    if cfg.depgraph:
+        # dependency-observatory companion ring, same SEPARATE-array
+        # discipline: non-depgraph traces carry nothing extra
+        out["arr_dep_trace"] = jnp.zeros(
+            (cfg.trace_ticks, len(DEP_COLUMNS)), jnp.int32)
     return out
 
 
@@ -208,6 +221,24 @@ def record_pipe(stats: dict, t, legs, lapped) -> dict:
                 row, unique_indices=True)}
 
 
+def record_dep(stats: dict, t, edges, depth, convoy) -> dict:
+    """Accumulate the tick's dependency-observatory row — edges latched
+    into the sampling ring this tick, the max wait-chain depth and the
+    convoy width (engine/scheduler.py computes all three from
+    obs/depgraph.py tick_planes).  Same wrap-and-accumulate discipline —
+    and the same warmup caveat — as :func:`record_tick`; no-op unless
+    the run traces with ``Config.depgraph``."""
+    if "arr_dep_trace" not in stats:
+        return stats
+    buf = stats["arr_dep_trace"]
+    row = jnp.stack([jnp.asarray(edges, jnp.int32),
+                     jnp.asarray(depth, jnp.int32),
+                     jnp.asarray(convoy, jnp.int32)])
+    return {**stats,
+            "arr_dep_trace": buf.at[t % buf.shape[0]].add(
+                row, unique_indices=True)}
+
+
 def record_slo(cfg, stats: dict, t) -> dict:
     """Record the SLO plane's per-family device-side gauges — the
     bucket-low p99 estimate (ticks) and the CUMULATIVE error-budget
@@ -277,6 +308,13 @@ def _pipe_buffer(state_or_stats) -> np.ndarray | None:
     return np.asarray(stats["arr_pipe_trace"])
 
 
+def _dep_buffer(state_or_stats) -> np.ndarray | None:
+    stats = getattr(state_or_stats, "stats", state_or_stats)
+    if "arr_dep_trace" not in stats:
+        return None
+    return np.asarray(stats["arr_dep_trace"])
+
+
 def _slo_buffer(state_or_stats) -> np.ndarray | None:
     stats = getattr(state_or_stats, "stats", state_or_stats)
     if "arr_slo_trace" not in stats:
@@ -312,6 +350,7 @@ def timeline(state_or_stats, per_shard: bool = False) -> dict:
     c = _ctrl_buffer(state_or_stats)
     sl = _slo_buffer(state_or_stats)
     p = _pipe_buffer(state_or_stats)
+    d = _dep_buffer(state_or_stats)
     if a.ndim == 3 and not per_shard:
         a = a.sum(axis=0)
         r = r.sum(axis=0) if r is not None else None
@@ -320,6 +359,11 @@ def timeline(state_or_stats, per_shard: bool = False) -> dict:
         c = c.sum(axis=0) if c is not None else None
         sl = sl.sum(axis=0) if sl is not None else None
         p = p.sum(axis=0) if p is not None else None
+        # depth/convoy are gauges, not flows — the cluster-wide view
+        # takes the max over shards (edges column sums would be the
+        # flow-correct merge, but a mixed reduce per column buys little;
+        # max keeps "worst chain anywhere" which is the question asked)
+        d = d.max(axis=0) if d is not None else None
     if a.ndim == 3:
         out = {name: a[:, :, i] for i, name in enumerate(TRACE_COLUMNS)}
         if r is not None:
@@ -339,6 +383,9 @@ def timeline(state_or_stats, per_shard: bool = False) -> dict:
         if p is not None:
             out.update({name: p[:, :, i]
                         for i, name in enumerate(PIPE_COLUMNS)})
+        if d is not None:
+            out.update({name: d[:, :, i]
+                        for i, name in enumerate(DEP_COLUMNS)})
         return out
     out = {name: a[:, i] for i, name in enumerate(TRACE_COLUMNS)}
     if r is not None:
@@ -356,6 +403,8 @@ def timeline(state_or_stats, per_shard: bool = False) -> dict:
                     in enumerate(_slo_names(sl.shape[-1]))})
     if p is not None:
         out.update({name: p[:, i] for i, name in enumerate(PIPE_COLUMNS)})
+    if d is not None:
+        out.update({name: d[:, i] for i, name in enumerate(DEP_COLUMNS)})
     return out
 
 
@@ -383,7 +432,8 @@ def to_chrome_trace(state_or_stats, path: str, n_ticks: int | None = None,
                     tick_us: float = 1.0,
                     xmeter: dict | None = None,
                     flight: dict | None = None,
-                    windows: dict | None = None) -> str:
+                    windows: dict | None = None,
+                    depgraph: dict | None = None) -> str:
     """Export the timeline as Chrome trace-event JSON (the JSON Array
     Format with counter events, loadable at ui.perfetto.dev).
 
@@ -402,7 +452,15 @@ def to_chrome_trace(state_or_stats, path: str, n_ticks: int | None = None,
     one cluster-wide counter per snapshot column, stepping at each
     window boundary by that window's delta — the coarse causal view
     (which phase of the run moved which counter) beside the per-tick
-    rows, derived host-side so the device plane stays two rings."""
+    rows, derived host-side so the device plane stays two rings.
+    ``depgraph`` (an obs/depgraph.py ``snapshot()`` or a run record's
+    ``"depgraph"`` block) adds blocker→waiter flow arrows from the
+    sampled wait-for edges; runs traced with ``Config.depgraph`` also
+    carry the 12th counter track, "chain depth" (per-tick sampled edges,
+    max wait-chain depth, convoy width).  Depgraph flow ids are strings
+    (``"dep<n>"``), disjoint by type from the flight track's integer
+    flow ids, so the two arrow families merge into one export without
+    Perfetto uniting unrelated arrows."""
     a = _buffer(state_or_stats)
     shards = a[None] if a.ndim == 2 else a          # (N, T, K)
     rbuf = _reason_buffer(state_or_stats)
@@ -429,6 +487,10 @@ def to_chrome_trace(state_or_stats, path: str, n_ticks: int | None = None,
     pshards = None
     if pbuf is not None:
         pshards = pbuf[None] if pbuf.ndim == 2 else pbuf
+    dbuf = _dep_buffer(state_or_stats)
+    dshards = None
+    if dbuf is not None:
+        dshards = dbuf[None] if dbuf.ndim == 2 else dbuf
     rnames = _reason_names()
     N, T, _ = shards.shape
     if n_ticks is not None:
@@ -511,6 +573,17 @@ def to_chrome_trace(state_or_stats, path: str, n_ticks: int | None = None,
                                "args": {c: int(pshards[node][t, i])
                                         for i, c in
                                         enumerate(PIPE_COLUMNS)}})
+            if dshards is not None:
+                # 12th counter track (same conditional discipline): the
+                # dependency observatory's per-tick planes — sampled
+                # wait/abort edges, max wait-chain depth (pointer
+                # doubling) and convoy width (Config.depgraph with
+                # tracing; obs/depgraph.py)
+                events.append({"name": "chain depth", "ph": "C",
+                               "ts": ts, "pid": node,
+                               "args": {c: int(dshards[node][t, i])
+                                        for i, c in
+                                        enumerate(DEP_COLUMNS)}})
     xentries = []
     if xmeter:
         # 5th counter track, present only when an xmeter snapshot is
@@ -558,6 +631,15 @@ def to_chrome_trace(state_or_stats, path: str, n_ticks: int | None = None,
         from deneva_tpu.obs import flight as obs_flight
         events.extend(obs_flight.span_events(flight, tick_us=tick_us))
         n_spans = len(flight.get("spans", ()))
+    n_dep_flows = 0
+    if depgraph:
+        # blocker→waiter flow arrows from the sampled wait-for graph
+        # (string flow ids — see docstring; obs/export.py relies on the
+        # int/str split when it re-keys flows across merged runs)
+        from deneva_tpu.obs import depgraph as obs_depgraph
+        dep_flows = obs_depgraph.flow_events(depgraph, tick_us=tick_us)
+        events.extend(dep_flows)
+        n_dep_flows = len(dep_flows) // 2
     doc = {"traceEvents": events, "displayTimeUnit": "ms",
            "metadata": {"tool": "deneva_tpu.obs.trace",
                         "columns": list(TRACE_COLUMNS),
@@ -574,6 +656,10 @@ def to_chrome_trace(state_or_stats, path: str, n_ticks: int | None = None,
         doc["metadata"]["slo_track"] = list(_slo_names(sshards.shape[-1]))
     if pshards is not None:
         doc["metadata"]["pipe_track"] = list(PIPE_COLUMNS)
+    if dshards is not None:
+        doc["metadata"]["dep_track"] = list(DEP_COLUMNS)
+    if depgraph:
+        doc["metadata"]["dep_flows"] = n_dep_flows
     if wcols:
         doc["metadata"]["window_track"] = wcols
     if xentries:

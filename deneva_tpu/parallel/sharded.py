@@ -63,6 +63,7 @@ from deneva_tpu.engine.scheduler import (STAT_KEYS_F32, STAT_KEYS_I32,  # noqa: 
                                          track_parts_touched,
                                          track_state_latencies)
 from deneva_tpu.faults import plan as fault_plan
+from deneva_tpu.obs import depgraph as obs_depgraph
 from deneva_tpu.obs import flight as obs_flight
 from deneva_tpu.obs import histo as obs_histo
 from deneva_tpu.obs import mesh as obs_mesh
@@ -111,6 +112,15 @@ SHARDED_COMM = routing.ROUTING_COMM + (
         role="data", when="remote_cache and plugin.remote_cache_ok",
         note="tick-start gather of (K,) per-bucket owner commit clocks; "
              "value movement, no reduction"),
+    cc_base.CommSpec(
+        name="depgraph.blocker_gather", op="all_gather",
+        site=("parallel/sharded.py", ("tick_fn",)),
+        role="data", when="depgraph",
+        note="per-tick gather of the (B,) GLOBAL blocker-pointer "
+             "planes into one cluster wait-for graph; value movement, "
+             "no reduction — every node runs the same pointer-doubling "
+             "depth kernel on the gathered graph and banks only its "
+             "own B lanes"),
     cc_base.CommSpec(
         name="repl.log_ship", op="collective_permute",
         site=("parallel/sharded.py", ("tick_fn",)),
@@ -172,6 +182,11 @@ def _init_net(cfg: Config, B: int, R: int) -> dict:
         # the decision word home, but applies (is counted) only when the
         # delayed abort reaches the home state machine
         out["abort_code"] = jnp.zeros(B, jnp.int32)
+    if cfg.depgraph:
+        # the blocker GLOBAL id latched with abort_due (obs/depgraph.py):
+        # the abort EDGE records when the decision applies at home, so
+        # the victim identity must survive the transit with it
+        out["dep_blk"] = jnp.full(B, -1, jnp.int32)
     return out
 
 
@@ -251,6 +266,9 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
         # DELTA of the cumulative note_compaction counters (cc/base.py)
         live_base = db.get("live_entry_cnt")
         ovf_base = db.get("compact_overflow_cnt")
+        # dependency-edge baseline: the trace row records this tick's
+        # DELTA of the cumulative edge-ring append count (obs/depgraph.py)
+        dep_base = stats.get("arr_dep_cnt")
 
         # ---- 1. backoff expiry + admission (home-local) ----
         expire = (txn.status == STATUS_BACKOFF) & (txn.backoff_until <= t)
@@ -533,6 +551,15 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
         }
         for f in plugin.txn_db_fields:
             fields[f] = jnp.broadcast_to(db[f][:, None], (B, R)).reshape(-1)
+        if cfg.depgraph:
+            # each entry's HOME txn identity in GLOBAL id space
+            # (node * B + slot, obs/depgraph.py): rides the entry to its
+            # owner so the arbitration victim can be named across node
+            # boundaries — the owner resolves its virtual-lane blocker
+            # through this plane and ships the GLOBAL id home
+            fields["gid"] = jnp.broadcast_to(
+                (node_id * B + jnp.arange(B, dtype=jnp.int32))[:, None],
+                (B, R)).reshape(-1)
 
         nE = B * R
         # lanes [0, N*cap): received remote entries; [N*cap, N*cap+nE):
@@ -812,6 +839,9 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
             o_flags = owner_cat(recv["flags"], fields["flags"])
             o_ts = owner_cat(recv["ts"], fields["ts"])
             o_stick = owner_cat(recv["start_tick"], fields["start_tick"])
+            if cfg.depgraph:
+                # GLOBAL txn ids of the virtual lanes (dead lanes -1)
+                o_gid = owner_cat(recv["gid"], fields["gid"], -1)
             o_live = o_key != NULL_KEY
             o_iw = (o_flags & 1) == 1
             o_held = (o_flags >> 1) & 1 == 1
@@ -857,8 +887,13 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
                 from deneva_tpu.cc.base import AccessDecision
                 o_req = (((o_flags >> 2) & 1) == 1) & o_live
                 z = jnp.zeros((Bv, 1), dtype=bool)
-                dec = AccessDecision(grant=o_req[:, None], wait=z,
-                                     abort=z)
+                # blocker plane present iff Config.depgraph, like every
+                # plugin path (decision STRUCTURE is static per config);
+                # the ladder grants everything, so all-zeros = none
+                dec = AccessDecision(
+                    grant=o_req[:, None], wait=z, abort=z,
+                    blocker=(jnp.zeros((Bv, 1), jnp.int32)
+                             if cfg.depgraph else None))
                 votes = o_fin
             if dly and plugin.release_on_vabort:
                 # refresh prepare marks of yes-voted txns still awaiting
@@ -888,12 +923,26 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
                                                dec.reason.reshape(-1), 0)
                                      << 4)
             back = {"decbits": decbits[:nR].reshape(n_nodes, cap)}
+            if cfg.depgraph:
+                # resolve the owner's victim (wire virtual-lane+1 in the
+                # Bv lane space, cc/base.py) to the victim's GLOBAL txn
+                # id through the shipped gid plane; -1 = no live
+                # opponent.  Validation victims (OCC dep_vblocker) are
+                # owner-local virtual lanes with no home mapping —
+                # sharded vabort edges carry blocker -1 by design, the
+                # exactness identities count edges, not identities.
+                vblk = (dec.blocker.reshape(-1) if dec.blocker is not None
+                        else jnp.zeros(Bv, jnp.int32))
+                blk_gid = jnp.where(
+                    vblk > 0, o_gid[jnp.clip(vblk - 1, 0, Bv - 1)], -1)
+                back["depblk"] = blk_gid[:nR].reshape(n_nodes, cap)
             for f in plugin.txn_db_fields:
                 back[f] = vdb[f][:nR].reshape(n_nodes, cap)
             if rcache:
                 for f in plugin.remote_cache_fields:
                     back["rcp_" + f] = rcp[f][:nR].reshape(n_nodes, cap)
             decb_loc = decbits[nR:]
+            blk_loc = blk_gid[nR:] if cfg.depgraph else None
             vdb_loc = {f: vdb[f][nR:] for f in plugin.txn_db_fields}
             # keep owner-updated ROW arrays; txn-keyed fields travel
             # back instead
@@ -905,6 +954,9 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
             # ---- 4. home: unpack decisions, advance, vote-gather ----
             defaults = {"decbits": jnp.zeros(nE + 1, jnp.int32).at[:].set(
                 jnp.int32(1 << 3))}  # unshipped: no decision, vote=yes
+            if cfg.depgraph:
+                # unshipped / overflowed lanes carry no blocker identity
+                defaults["depblk"] = jnp.full(nE + 1, -1, jnp.int32)
             for f in plugin.txn_db_fields:
                 defaults[f] = jnp.concatenate(
                     [jnp.broadcast_to(db[f][:, None], (B, R)).reshape(-1),
@@ -930,6 +982,12 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
         abort_e = ((decb >> 2) & 1) == 1
         vote_e = ((decb >> 3) & 1) == 1
         reason_e = (decb >> 4) & 15 if cfg.abort_attribution else None
+        blk_e = None
+        if cfg.depgraph:
+            # per-entry blocker GLOBAL ids returned from the owners
+            # (cache-hit lanes grant at home and never index the plane)
+            blk_e = jnp.where(local_e, blk_loc,
+                              got["depblk"][:nE]).reshape(B, R)
         if dly:
             # the owner's grant took effect at its end (the row is locked /
             # the prewrite buffered from tick t), but the response reaches
@@ -1039,6 +1097,13 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
                                              reason_e, 0), axis=1)
                 net["abort_code"] = jnp.where(latch_abt, code_raw,
                                               net["abort_code"])
+            if "dep_blk" in net:
+                # latch the victim's GLOBAL id with it (the edge records
+                # when the abort applies at home, obs/depgraph.py)
+                blk_raw = jnp.max(jnp.where((ridx == fail_pos) & abort_e,
+                                            blk_e, -1), axis=1)
+                net["dep_blk"] = jnp.where(latch_abt, blk_raw,
+                                           net["dep_blk"])
             abort_now = (active & (net["abort_due"] <= t)) | vabort
 
             # network-wait decomposition (per-message network time the
@@ -1109,6 +1174,26 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
             net["launch"] = jnp.where(advanced, t, net["launch"])
         stats = bump(stats, "twopl_wait_cnt",
                      jnp.sum(wait.astype(jnp.int32)), measuring)
+        dep_blk_g = None
+        if cfg.depgraph:
+            # blocker GLOBAL id at the failing access.  Wait EDGES
+            # record at the EXACT mask of the twopl_wait_cnt bump above
+            # (the identity dep_wait_edge_cnt == twopl_wait_cnt holds
+            # per node, hence under the cluster psum too), then the
+            # blocker-pointer plane feeds the end-of-tick cluster
+            # chain/convoy kernel below.  A blocker on another node
+            # (gid // B != node_id) marks the edge cross-node — the
+            # dep_cross_edge_cnt the 16n zipf-head residual hides in.
+            dep_blk_g = jnp.max(jnp.where(ridx == fail_pos, blk_e, -1),
+                                axis=1)
+            wkey = jnp.sum(jnp.where(ridx == fail_pos, txn.keys, 0),
+                           axis=1)
+            cross_w = (dep_blk_g >= 0) & (dep_blk_g // B != node_id)
+            stats = obs_depgraph.record_edges(
+                stats, "dep_wait_edge_cnt", wait, dep_blk_g,
+                jnp.where(wait, wkey, NULL_KEY), 0, t, measuring,
+                node=node_id, cross_b=cross_w)
+            stats = obs_depgraph.note_waits(stats, wait, dep_blk_g)
 
         # ---- 5. commit exchange (B / RFIN): apply at owners ----
         cts = db[plugin.commit_ts_field] if plugin.commit_ts_field else txn.ts
@@ -1511,7 +1596,11 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
             # vabort partition: a genuine validation failure carries the
             # plugin's vabort_reason; a routing-overflow kill is transport
             vcode_b = jnp.where(vabort_apply, vabort_code, route_code)
-            stats = note_aborts(cfg, stats, vcode_b, vabort, measuring, t=t)
+            # sharded vabort edges carry no blocker (-1): the OCC
+            # validation victim is an owner-local virtual lane — see the
+            # owner-side depblk note in exchange A
+            stats = note_aborts(cfg, stats, vcode_b, vabort, measuring,
+                                t=t, node=node_id)
 
         stats = track_parts_touched(stats, txn, commit, n_parts, measuring)
         stats = record_commit_latency(stats, commit, t, txn.start_tick,
@@ -1533,7 +1622,7 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
         if cfg.abort_attribution:
             stats = note_aborts(cfg, stats,
                                 jnp.full((B,), ua_code, jnp.int32), ua,
-                                measuring, t=t)
+                                measuring, t=t, node=node_id)
         stats = obs_flight.harvest_spans(stats, commit | ua, ua, txn, t)
         status = jnp.where(commit | ua, STATUS_FREE, status)
 
@@ -1554,9 +1643,23 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
             code_b = jnp.where(vabort,
                                jnp.where(vabort_apply, vabort_code,
                                          route_code), code_b)
+            dep_ab_blk = None
+            cross_ab = None
+            if cfg.depgraph:
+                # abort-edge blockers: the access-failure victim's
+                # GLOBAL id from the owner's returned plane (net_delay
+                # mode: latched with the abort decision); vabort lanes
+                # carry -1 — see the owner-side depblk note
+                ab_blk = (net["dep_blk"] if dly else
+                          jnp.max(jnp.where((ridx == fail_pos) & abort_e,
+                                            blk_e, -1), axis=1))
+                dep_ab_blk = jnp.where(acc_ab, ab_blk, -1)
+                cross_ab = (dep_ab_blk >= 0) & (dep_ab_blk // B != node_id)
             stats = note_aborts(cfg, stats, code_b, abort_now, measuring,
                                 t=t,
-                                key_b=jnp.where(acc_ab, fail_key, NULL_KEY))
+                                key_b=jnp.where(acc_ab, fail_key, NULL_KEY),
+                                blocker_b=dep_ab_blk, node=node_id,
+                                cross_b=cross_ab)
             stats = note_last_abort(
                 stats, abort_now | ua, jnp.where(ua, ua_code, code_b),
                 jnp.where(acc_ab, fail_key, NULL_KEY))
@@ -1605,6 +1708,8 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
             net["vote_ok"] = jnp.where(done, False, net["vote_ok"])
             if "abort_code" in net:
                 net["abort_code"] = jnp.where(done, 0, net["abort_code"])
+            if "dep_blk" in net:
+                net["dep_blk"] = jnp.where(done, -1, net["dep_blk"])
 
         if cfg.adaptive:
             # controller step (per node).  ladder_len=1: the sharded
@@ -1617,6 +1722,19 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
         # network = entry-ticks shipped to remote owners this tick)
         stats = track_state_latencies(stats, txn, measuring)
         stats = obs_flight.track_phases(stats, txn, t, measuring)
+        dep_dmax = dep_conv = jnp.int32(0)
+        if cfg.depgraph:
+            # cluster wait-for graph: gather every node's (B,) GLOBAL
+            # blocker plane (depgraph.blocker_gather CommSpec), run the
+            # pointer-doubling chain/convoy kernel over the WHOLE graph
+            # (identical on every node), then bank only this node's own
+            # B lanes — the counter psum counts each lane exactly once
+            # while a chain crossing nodes still measures its true depth
+            # on every member's home node
+            ptr_g = jax.lax.all_gather(stats["arr_dep_blocker"],
+                                       AXIS).reshape(-1)
+            stats, dep_dmax, dep_conv = obs_depgraph.tick_planes(
+                stats, measuring, ptr=ptr_g, lo=node_id * B)
         if cfg.trace_ticks > 0:
             live_delta, ovf_delta = 0, 0
             if "live_entry_cnt" in db:
@@ -1638,6 +1756,10 @@ def make_sharded_tick(cfg: Config, plugin, pool_dev: dict, n_nodes: int,
             stats = obs_trace.record_queue(stats, t)
             stats = obs_trace.record_ctrl(stats, t)
             stats = obs_trace.record_slo(cfg, stats, t)
+            if dep_base is not None:
+                stats = obs_trace.record_dep(
+                    stats, t, stats["arr_dep_cnt"] - dep_base,
+                    dep_dmax, dep_conv)
             # per-dest sent counts into the mesh companion ring (the
             # per-node-pair Perfetto counter tracks; obs/mesh.py)
             stats = obs_mesh.note_trace(stats, t, mesh_per_dest)
@@ -2115,6 +2237,12 @@ class ShardedEngine:
             # merged only when the plane is on.  The float(...sum())
             # scrape above never sees the plane (arr_ prefix).
             out.update(obs_windows.summary_keys(self.cfg, state.stats))
+        if "arr_dep_cnt" in state.stats:
+            # dependency observatory (obs/depgraph.py): ring fill / wrap
+            # flag (max across nodes — wrap is per-ring) and the peak
+            # chain-depth / convoy-width gauges (max-merged, never
+            # summed); the dep_* scalars already rode the psum above
+            out.update(obs_depgraph.summary_keys(state.stats))
         return out
 
     def mesh_snapshot(self, state: ShardState) -> dict:
@@ -2132,6 +2260,25 @@ class ShardedEngine:
         """Device-psum'd cluster latency histogram (obs/histo.py) —
         bit-exact equal to the host ``sum(axis=0)`` of the node-stacked
         per-shard planes (exact merge: elementwise int32 add)."""
+        return obs_histo.cluster_plane(self.mesh, state.stats[key])
+
+    def depgraph_snapshot(self, state: ShardState) -> dict:
+        """Host-side dependency-observatory snapshot (obs/depgraph.py):
+        the node-stacked planes merge there — per-node rings interleave
+        on the shared tick clock with GLOBAL blocker ids, summable
+        planes sum, peak gauges max."""
+        return obs_depgraph.snapshot(state)
+
+    def depgraph_cluster_plane(self, state: ShardState,
+                               key: str = "arr_dep_depth_hist"
+                               ) -> np.ndarray:
+        """Device-psum'd cluster depgraph plane (``arr_dep_depth_hist``
+        or ``arr_dep_part``) over the node axis — bit-exact equal to the
+        host ``sum(axis=0)`` of the node-stacked per-shard planes (exact
+        merge: elementwise int32 add; the same ``counters.cluster_sum``
+        collective as the histogram plane).  Each node banked only its
+        own B lanes of the gathered cluster graph, so the psum counts
+        every waiting lane exactly once."""
         return obs_histo.cluster_plane(self.mesh, state.stats[key])
 
     def window_snapshot(self, state: ShardState) -> dict | None:

@@ -208,6 +208,18 @@ def reference_summary(s: dict, wall_seconds: float | None = None) -> dict:
             out[k] = s[k]
         elif k.startswith("slo_") and k not in out:
             out[k] = s[k] * tick_sec if isinstance(s[k], float) else s[k]
+    # conflict dependency observatory keys (Config.depgraph,
+    # obs/depgraph.py): wait/abort edge counts, the chain-depth and
+    # convoy-width integrals, the cross-node edge count and the sampling
+    # ring bookkeeping (kept count, wrap flag, peak gauges) pass through
+    # verbatim (integers — never time-scaled; the reconciliation
+    # identities dep_wait_edge_cnt == twopl_wait_cnt and
+    # dep_abort_edge_cnt == sum(abort_*_cnt) are checkable from the line
+    # alone).  Present only when the observatory is on, so the default
+    # line stays byte-identical.
+    for k in sorted(s):
+        if k.startswith("dep_") and k not in out:
+            out[k] = s[k]
     # causal-diagnosis observatory keys (Config.windows, obs/windows.py
     # + obs/diff.py): the snapshot-ring bookkeeping (latch count, wrap
     # flag, ring geometry) and any diag_* diagnosis gauges pass through

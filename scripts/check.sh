@@ -113,6 +113,35 @@ if [ "$flt_rc" -ne 0 ]; then
     exit "$flt_rc"
 fi
 
+echo "== depgraph smoke (wait-for graph + critical paths) =="
+# the conflict dependency observatory (Config.depgraph,
+# deneva_tpu/obs/depgraph.py) on the contended zipf-0.9 cell: an
+# abort-only plugin (NO_WAIT) and a waiting one (MVCC) must both
+# reconcile their sampled edges EXACTLY against the wait/abort counter
+# integrals (a wrapped ring refuses loudly; rc=1 on any mismatch or a
+# post-warm recompile under the xmeter sentinel, ORed with the
+# watchdog bitmask minus the expected CONVOY bit), the report
+# must render a [depgraph] section, and the merged Perfetto trace must
+# carry the blocker->waiter flow arrows in the per-record "<pid>:dep<n>"
+# flow-id namespace
+dep_dir=$(mktemp -d)
+env JAX_PLATFORMS=cpu python bench.py --depgraph --algs NO_WAIT,MVCC \
+    --ticks 40 --no-history --out-dir "$dep_dir"
+dep_rc=$?
+if [ "$dep_rc" -eq 0 ]; then
+    env JAX_PLATFORMS=cpu python -m deneva_tpu.obs.report \
+        "$dep_dir"/run_depgraph_mvcc.json | grep -q '^\[depgraph\]' \
+    && env JAX_PLATFORMS=cpu python -m deneva_tpu.obs.export \
+        "$dep_dir"/run_depgraph_*.json -o "$dep_dir/depgraph_trace.json" \
+    && grep -q '"id": "[0-9]*:dep' "$dep_dir/depgraph_trace.json"
+    dep_rc=$?
+fi
+rm -rf "$dep_dir"
+if [ "$dep_rc" -ne 0 ]; then
+    echo "depgraph smoke FAILED (reconcile/report/flows rc=$dep_rc)"
+    exit "$dep_rc"
+fi
+
 echo "== fused arbitration smoke (parity + sort-count) =="
 # the fused VMEM sort+scan kernel (Config.fused_arbitrate, ops/fused.py)
 # on one small contended MAAT cell, interpret mode on CPU: the [summary]

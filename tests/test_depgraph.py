@@ -1,0 +1,291 @@
+"""Conflict dependency observatory tests (deneva_tpu/obs/depgraph.py).
+
+The observatory is an accounting identity, not an estimate — with the
+edge ring unwrapped, the sampled wait-for edges must reconcile EXACTLY
+against the ``twopl_wait_cnt`` integral and partition EXACTLY into the
+``abort_*_cnt`` taxonomy, for every CC plugin and both engines.  A
+wrapped ring must refuse loudly.  The off path (``Config.depgraph``
+False, the default) carries zero extra device arrays and is proved
+byte-identical by the lint certifier (tests/test_certify.py); here we
+assert the array surface directly.  The Perfetto flow arrows use a
+string id namespace that must never collide with the flight recorder's
+integer abort-flow ids when obs/export.py merges both span sources.
+"""
+
+import numpy as np
+import pytest
+
+from deneva_tpu.cc import base as cc_base
+from deneva_tpu.config import Config
+from deneva_tpu.engine.scheduler import Engine
+from deneva_tpu.obs import depgraph as obs_depgraph
+from deneva_tpu.obs import export as obs_export
+from deneva_tpu.obs import flight as obs_flight
+from deneva_tpu.obs import report as obs_report
+
+BASE = dict(batch_size=64, synth_table_size=256, req_per_query=4,
+            zipf_theta=0.9, query_pool_size=256, warmup_ticks=0)
+
+#: the exact device-array surface the observatory adds (keep in sync
+#: with obs/depgraph.py init_depgraph — the off-path purity test
+#: asserts the set)
+DEP_STATS_KEYS = {
+    "arr_dep_ring", "arr_dep_blocker", "arr_dep_depth_hist",
+    "arr_dep_part", "arr_dep_peak", "arr_dep_cnt",
+    "dep_wait_edge_cnt", "dep_abort_edge_cnt", "dep_nullkey_edge_cnt",
+    "dep_cross_edge_cnt", "dep_depth_sum", "dep_convoy_width_sum",
+}
+
+
+def dep_cfg(**kw):
+    base = dict(cc_alg="WAIT_DIE", depgraph=True, abort_attribution=True,
+                **BASE)
+    base.update(kw)
+    return Config(**base)
+
+
+def run(cfg, n_ticks=64):
+    eng = Engine(cfg)
+    st = eng.run(n_ticks)
+    return eng, st, eng.summary(st)
+
+
+# MAAT's chain-validate compile alone costs ~10 s — `-m slow` per the
+# tier-1 870 s budget split (its reconciliation shape is OCC's)
+@pytest.mark.parametrize("alg", ["NO_WAIT", "WAIT_DIE", "TIMESTAMP",
+                                 "MVCC", "OCC",
+                                 pytest.param("MAAT",
+                                              marks=pytest.mark.slow),
+                                 "CALVIN"])
+def test_reconciles_exactly(alg):
+    """wait edges == twopl_wait_cnt, abort edges partition into the
+    abort taxonomy per reason, partition plane sums — every plugin."""
+    _, st, summary = run(dep_cfg(cc_alg=alg, warmup_ticks=8))
+    snap = obs_depgraph.snapshot(st.stats)
+    assert not snap["wrapped"]
+    bad = obs_depgraph.reconcile(snap, summary, warmup_ticks=8)
+    assert not bad, bad
+    # every edge row is well-formed: waiter in range, reason registered
+    B = snap["batch"]
+    reasons = len(cc_base.ABORT_REASONS)
+    for e in snap["edges"]:
+        assert 0 <= e["waiter"] < B
+        assert 0 <= e["reason"] <= reasons
+        assert -1 <= e["blocker"] < B
+
+
+def test_wait_chains_and_convoys_measured():
+    """A WAIT-capable plugin under zipf 0.9 must measure real chains:
+    nonzero depth, nonzero convoy width, histogram mass above bin 1.
+    (warmup_ticks=8 shares the jit cache with the reconcile cell — the
+    tier-1 870 s budget again)"""
+    _, st, summary = run(dep_cfg(cc_alg="WAIT_DIE", warmup_ticks=8))
+    snap = obs_depgraph.snapshot(st.stats)
+    assert summary["dep_peak_depth"] >= 2
+    assert summary["dep_peak_convoy"] >= 2
+    assert sum(snap["depth_hist"][2:]) > 0
+    assert snap["dep_depth_sum"] >= snap["dep_wait_edge_cnt"]
+
+
+def test_ring_wrap_refuses_loudly():
+    """An overfull ring must refuse reconciliation as the SOLE finding
+    — approximate identities are never reported."""
+    _, st, summary = run(dep_cfg(cc_alg="TIMESTAMP", dep_samples=32))
+    snap = obs_depgraph.snapshot(st.stats)
+    assert snap["wrapped"]
+    bad = obs_depgraph.reconcile(snap, summary)
+    assert len(bad) == 1 and bad[0][0] == "dep_ring_wrapped"
+    assert summary["dep_ring_wrapped"] == 1
+
+
+@pytest.mark.parametrize("alg", ["WAIT_DIE",
+                                 pytest.param("OCC",
+                                              marks=pytest.mark.slow)])
+def test_depgraph_off_carries_nothing(alg):
+    """The default path must not carry a single observatory array and
+    its [summary] must not leak a dep_* key (the certifier proves the
+    byte-level claim for every plugin x both engines; this pins the
+    array surface — one lock + one validation plugin, the second
+    slow-marked for the tier-1 budget)."""
+    _, st, summary = run(Config(cc_alg=alg, abort_attribution=True,
+                                **BASE), n_ticks=16)
+    assert not (DEP_STATS_KEYS & set(st.stats))
+    assert not [k for k in summary if k.startswith("dep_")]
+    _, st2, _ = run(dep_cfg(cc_alg=alg), n_ticks=16)
+    assert DEP_STATS_KEYS <= set(st2.stats)
+
+
+def test_chain_depths_pointer_doubling():
+    """The log-depth kernel against a hand-walked graph: a 4-chain, an
+    isolated lane, a 2-cycle (saturates), a self-loop (masked)."""
+    #        0 -> 1 -> 2 -> 3    4    5 <-> 6    7 -> 7
+    ptr = np.array([1, 2, 3, -1, -1, 6, 5, 7], np.int32)
+    d = np.asarray(obs_depgraph.chain_depths(ptr))
+    assert d[3] == 0 and d[2] == 1 and d[1] == 2 and d[0] == 3
+    assert d[4] == 0
+    assert d[5] >= len(ptr) and d[6] >= len(ptr)   # cycle saturates
+    assert d[7] == 0                               # self-loop masked
+
+
+def _synth_snap(edges, nodes=1, batch=64):
+    reasons = ("wait",) + tuple(cc_base.ABORT_REASONS)
+    rows = []
+    for w, b, key, reason, tick, node in edges:
+        rows.append({"waiter": w, "blocker": b, "key": key,
+                     "reason": reason, "tick": tick, "node": node,
+                     "why": reasons[reason]})
+        if nodes > 1 and b >= 0:
+            rows[-1]["blocker_node"] = b // batch
+            rows[-1]["blocker_slot"] = b % batch
+    return {"columns": list(obs_depgraph.EDGE_COLUMNS), "nodes": nodes,
+            "samples": 1 << 10, "batch": batch, "edge_cnt": len(rows),
+            "wrapped": False, "edges": rows,
+            "depth_hist": [0] * obs_depgraph.DEPTH_BINS,
+            "part_edges": [len(rows)], "peak_depth": 0,
+            "peak_convoy": 0, "dep_wait_edge_cnt": len(rows),
+            "dep_abort_edge_cnt": 0, "dep_nullkey_edge_cnt": 0,
+            "dep_cross_edge_cnt": 0, "dep_depth_sum": 0,
+            "dep_convoy_width_sum": 0}
+
+
+def test_cycles_found_per_tick():
+    """A 3-cycle at tick 5 is found once; a chain at tick 6 is not a
+    cycle; cross-tick pointers never merge into one graph."""
+    snap = _synth_snap([(0, 1, 9, 0, 5, 0), (1, 2, 9, 0, 5, 0),
+                        (2, 0, 9, 0, 5, 0),          # cycle @5
+                        (3, 4, 7, 0, 6, 0),          # chain @6
+                        (4, 3, 7, 0, 7, 0)])         # back-edge @7 only
+    cyc = obs_depgraph.cycles(snap)
+    assert len(cyc) == 1 and cyc[0]["tick"] == 5
+    assert sorted(s for _, s in cyc[0]["cycle"]) == [0, 1, 2]
+
+
+def test_critical_paths_join_flight_spans():
+    """The longest blocking chain behind a committed span, walked from
+    the span's own slot through the sampled tick graphs."""
+    snap = _synth_snap([(0, 1, 9, 0, 5, 0), (1, 2, 9, 0, 5, 0),
+                        (0, 1, 9, 0, 6, 0)])
+    fsnap = {"spans": [{"kind": 0, "node": 0, "slot": 0, "admit": 4,
+                        "end": 8, "block": 3}]}
+    rows = obs_depgraph.critical_paths(snap, fsnap)
+    assert rows and rows[0]["max_depth"] == 2 and rows[0]["at_tick"] == 5
+    assert [e["waiter"] for e in rows[0]["path"]] == [0, 1]
+
+
+def test_flow_events_schema_and_blockerless_skip():
+    """String ``dep<n>`` flow ids, s/f pairs, blocker -1 edges draw no
+    arrow (a vertex that does not exist)."""
+    snap = _synth_snap([(0, 1, 9, 0, 5, 0), (2, -1, 7, 0, 5, 0),
+                        (3, 0, 7, 2, 6, 0)])
+    evs = obs_depgraph.flow_events(snap)
+    assert len(evs) == 4                       # 2 arrows x (s, f)
+    assert {e["ph"] for e in evs} == {"s", "f"}
+    assert all(isinstance(e["id"], str) and e["id"].startswith("dep")
+               for e in evs)
+    assert evs[2]["name"].startswith("kills:")
+
+
+def test_export_flow_id_namespaces_never_collide(tmp_path):
+    """The obs/export.py regression: merging a record whose flight span
+    track emits integer abort-flow ids with its own depgraph string
+    flows — and a SECOND record of both — must keep all four flow-id
+    families disjoint (Perfetto unites flow phases by id alone)."""
+    cfg = dep_cfg(cc_alg="WAIT_DIE", flight=True,
+                  flight_samples=1 << 14)
+    eng, st, summary = run(cfg)
+    rec = {"timeline": {},
+           "flight": obs_flight.snapshot(st.stats),
+           "depgraph": obs_depgraph.snapshot(st.stats)}
+    ev0 = obs_export.record_events(rec, pid_base=0)
+    ev1 = obs_export.record_events(rec,
+                                   pid_base=obs_export.PID_STRIDE)
+
+    def flow_ids(evs):
+        return {(e["ph"], e["id"]) for e in evs
+                if e["ph"] in ("s", "t", "f")}
+
+    f0, f1 = flow_ids(ev0), flow_ids(ev1)
+    assert f0 and f1, "both records must emit flow arrows"
+    assert not ({i for _, i in f0} & {i for _, i in f1}), \
+        "per-record flow-id namespaces must be disjoint"
+    # within one record every id is the STRING "<pid_base>:<fid>"
+    # (additive integer striding aliased records — the original bug);
+    # the flight family keeps an all-digit suffix, depgraph a "dep<n>"
+    # suffix, so the two families stay disjoint inside the record too
+    ids0 = {i for _, i in f0}
+    assert all(isinstance(i, str) and i.startswith("0:") for i in ids0)
+    flight0 = {i for i in ids0 if i.split(":", 1)[1].isdigit()}
+    dep0 = {i for i in ids0 if i.split(":", 1)[1].startswith("dep")}
+    assert flight0 and dep0, "both flow families must be present"
+    assert flight0 | dep0 == ids0 and not (flight0 & dep0)
+
+
+def test_report_section_and_convoy_watchdog():
+    """[depgraph] renders with the headline identities; the CONVOY bit
+    (256) arms on a run-mean convoy width >= CONVOY_WIDTH_MIN."""
+    _, st, summary = run(dep_cfg(cc_alg="TIMESTAMP"))
+    snap = obs_depgraph.snapshot(st.stats)
+    rep = obs_report.build_report(summary, depgraph=snap)
+    txt = obs_report.render_text(rep)
+    assert "[depgraph]" in txt and "chain depth" in txt
+    mean_w = summary["dep_convoy_width_sum"] / max(
+        summary["measured_ticks"], 1)
+    flagged = any(n == "CONVOY" for n, _ in
+                  rep["watchdog"]["findings"])
+    assert flagged == (mean_w >= obs_report.CONVOY_WIDTH_MIN)
+    if flagged:
+        assert rep["watchdog"]["exit_code"] & obs_report.CONVOY
+
+
+def test_regress_chain_depth_ceiling_self_arms_then_gates():
+    """The bench.py --depgraph history record: the per-alg peak chain
+    depth feeds an INVERTED obs/regress.py ceiling (depth GROWING past
+    the prior median = the same cell serializing commits behind longer
+    chains), self-arming on the first recorded sweep."""
+    from deneva_tpu.obs import regress
+    doc1 = {"metric": "depgraph_chain", "value": 8.0,
+            "depgraph_chain": {"WAIT_DIE": {"max_chain_depth": 8}}}
+    doc2 = {"metric": "depgraph_chain", "value": 30.0,
+            "depgraph_chain": {"WAIT_DIE": {"max_chain_depth": 30}}}
+    e1 = regress._entry("h", (1, 1.0), doc1)
+    e2 = regress._entry("h", (1, 2.0), doc2)
+    # first sweep: no prior -> the ceiling self-arms, nothing fails
+    r1 = regress.gate([e1])
+    assert not r1["failures"]
+    assert any("depgraph_max_chain_depth[WAIT_DIE]" in s
+               for s in r1["skipped"])
+    # second sweep: depth ~4x the median -> regression
+    r2 = regress.gate([e1, e2])
+    assert any("depgraph_max_chain_depth[WAIT_DIE]" in f
+               for f in r2["failures"])
+
+
+def test_depgraph_excludes_exchange_split():
+    with pytest.raises(AssertionError):
+        Config(cc_alg="CALVIN", depgraph=True, abort_attribution=True,
+               exchange_split=True, **BASE)
+
+
+def test_sharded_reconciles_psum_parity_and_cross_node_chain():
+    """4-node zipf-0.9: exact cluster reconciliation, device-psum'd
+    depth/partition planes bit-equal to the numpy shard sum, and at
+    least one measured CROSS-NODE blocking chain (global blocker ids)."""
+    from deneva_tpu.parallel.sharded import ShardedEngine
+    cfg = dep_cfg(batch_size=32, synth_table_size=512, node_cnt=4,
+                  part_cnt=4, query_pool_size=256)
+    eng = ShardedEngine(cfg)
+    st = eng.run(48, eng.init_state())
+    summary = eng.summary(st)
+    snap = eng.depgraph_snapshot(st)
+    bad = obs_depgraph.reconcile(snap, summary)
+    assert not bad, bad
+    for key in ("arr_dep_depth_hist", "arr_dep_part"):
+        dev = eng.depgraph_cluster_plane(st, key)
+        host = np.asarray(st.stats[key]).sum(axis=0)
+        assert (dev == host).all(), key
+    cross = [e for e in snap["edges"] if e["blocker"] >= 0
+             and e["blocker_node"] != e["node"]]
+    assert summary["dep_cross_edge_cnt"] > 0 and cross, \
+        "a 4-node zipf-0.9 cell must measure cross-node blocking"
+    # the cross-node population in the ring matches the counter
+    assert len(cross) == summary["dep_cross_edge_cnt"]

@@ -207,6 +207,37 @@ def test_fault_keys_round_trip_exactly():
                    for k in p0)
 
 
+def test_dep_keys_round_trip_exactly():
+    """Conflict-dependency-observatory runs (Config.depgraph,
+    obs/depgraph.py) put the edge counters, the chain-depth/convoy
+    integrals, and the sampling-ring bookkeeping on the [summary] line;
+    the stats layer passes them through VERBATIM (integers, never
+    time-scaled), they round-trip through the parser port exactly, and
+    the default line carries none."""
+    eng, st = run_engine()
+    s = eng.summary(st)
+    # the passthrough is engine-agnostic: inject the documented key set
+    # (tests/test_depgraph.py covers both engines producing them)
+    dep = {"dep_wait_edge_cnt": 190, "dep_abort_edge_cnt": 303,
+           "dep_nullkey_edge_cnt": 0, "dep_cross_edge_cnt": 893,
+           "dep_depth_sum": 71, "dep_convoy_width_sum": 42,
+           "dep_ring_cnt": 493, "dep_ring_wrapped": 0,
+           "dep_peak_depth": 8, "dep_peak_convoy": 3}
+    d1 = stats_mod.reference_summary({**s, **dep})
+    d2 = stats_mod.reference_summary({**s, **dep},
+                                     wall_seconds=s["measured_ticks"]
+                                     * 2.0)
+    for k, v in dep.items():
+        assert d1[k] == v, k                       # verbatim
+        assert d2[k] == v, k                       # never time-scaled
+    parsed = stats_mod.parse_summary(stats_mod.format_summary(d1))
+    for k, v in dep.items():
+        assert parsed[k] == v, k
+    # the default (depgraph-off) line carries none of them
+    p0 = stats_mod.parse_summary(eng.summary_line(st, wall_seconds=1.0))
+    assert not any(k.startswith("dep_") for k in p0)
+
+
 def test_cc_case_counter_families():
     """The per-algorithm families (reference maat_case1/3 + this build's
     chain counters, occ check aborts) ride the [summary] line VERBATIM
